@@ -1,0 +1,23 @@
+"""FIRA-TPU: a TPU-native framework for fine-grained graph-based commit
+message generation.
+
+A ground-up JAX/Flax/XLA rebuild with the capabilities of the FIRA
+reference codebase (ICSE 2022, DJjjjhao/FIRA-ICSE): diff-graph encoding
+with a GCN stack, a Transformer decoder with a dual copy mechanism, beam
+search decoding, the full preprocessing pipeline (hunk FSM, Java AST
+parse + tree diff), and the evaluation metric suite — redesigned for TPU
+hardware (SPMD over device meshes, fixed-shape jitted programs, MXU-sized
+matmuls, COO edge lists instead of host-side dense adjacencies).
+
+Package map (component numbers refer to SURVEY.md §2):
+  fira_tpu.config       — typed config system, named configs (C1)
+  fira_tpu.data         — vocab, corpus schema, graph assembly, batching (C2)
+  fira_tpu.model        — Flax encoder/decoder/copy head (C3-C6)
+  fira_tpu.train        — jitted train step, mesh parallelism, checkpoints (C1, C20)
+  fira_tpu.decode       — greedy dev decode + jitted beam search (C7)
+  fira_tpu.eval         — B-Norm BLEU, Penalty-BLEU, ROUGE-L, METEOR (C14-C16)
+  fira_tpu.preprocess   — hunk FSM, Java lexer, shard pipeline, astdiff (C8-C13)
+  fira_tpu.parallel     — mesh/sharding helpers (C20-C21 TPU equivalents)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,155 @@
+"""Typed configuration for FIRA-TPU.
+
+The reference keeps hyperparameters in a hardcoded DotDict literal in the
+driver (/root/reference/run_model.py:30-46) with no CLI surface beyond the
+positional ``train|test``. Here every knob is a frozen dataclass field, with
+the reference values as defaults, plus named configs (fira-tiny / fira-full /
+fira-large per BASELINE.json) and the three paper ablations as switches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class FiraConfig:
+    # --- sequence geometry (reference run_model.py:31-35) ---
+    sou_len: int = 210          # diff tokens incl. <start>/<eos>
+    tar_len: int = 30           # message tokens incl. <start>/<eos>
+    att_len: int = 25           # max sub-tokens per integral token
+    ast_change_len: int = 280   # AST-type nodes + edit-op nodes
+    sub_token_len: int = 160    # deduplicated sub-token nodes
+
+    # --- model (reference run_model.py:37-39, gnn_transformer.py:41-43) ---
+    embedding_dim: int = 256
+    num_head: int = 8
+    num_layers: int = 6         # shared by GCN stack and decoder
+    dropout_rate: float = 0.1   # attention / FFN / combination dropout
+    gcn_dropout_rate: float = 0.2  # GCN-layer dropout (gnn_transformer.py:43)
+    ffn_mult: int = 4           # FFN hidden = 4 * d (gnn_transformer.py:166)
+
+    # --- vocabulary (filled in from data; run_model.py:44-56) ---
+    vocab_size: int = 0
+    ast_change_vocab_size: int = 0
+
+    # --- optimization (run_model.py:36,40-43,396) ---
+    lr: float = 1e-4
+    batch_size: int = 170       # per-chip batch; reference scales 170 x n_gpus
+    test_batch_size: int = 20
+    epochs: int = 150
+    beam_size: int = 3
+    seed: int = 0
+    # dev-gating cadence (run_model.py:89: epoch>=15, every 10 batches)
+    dev_start_epoch: int = 15
+    dev_every_batches: int = 10
+
+    # --- ablations (paper Table 3; OUTPUT/output_fira_{no_edit,no_subtoken,nothing}) ---
+    use_edit: bool = True           # False => drop change nodes + change edges
+    use_subtoken_copy: bool = True  # False => no sub-token copy labels/pointer span
+
+    # --- TPU-first data layout ---
+    # Adjacency travels host->device as padded COO (senders/receivers/values),
+    # NOT a dense graph_len^2 array (the reference densifies per sample,
+    # Dataset.py:336-343 — its biggest throughput sin). Densification to a
+    # batch of graph_len^2 happens once per step inside the jitted program.
+    max_edges: int = 8192       # padded COO length per sample (measured p100 < 6k)
+
+    # --- precision ---
+    # Compute dtype for matmuls/attention. Params and the fused output
+    # distribution stay float32 for parity; bf16 is the TPU fast path.
+    compute_dtype: str = "float32"
+
+    # --- decode ---
+    beam_compat_prob_space: bool = True  # reference prob-space accumulation
+                                         # (run_model.py:271,305); False => log-space
+
+    @property
+    def graph_len(self) -> int:
+        # 650 = 210 + 160 + 280 (run_model.py note; paper §5.4 "up to 650 nodes")
+        return self.sou_len + self.sub_token_len + self.ast_change_len
+
+    @property
+    def copy_len(self) -> int:
+        # pointer span: diff positions + sub-token positions
+        return self.sou_len + self.sub_token_len
+
+    @property
+    def output_vocab_size(self) -> int:
+        # fused gen+copy distribution width (Model.py:81: 24650+210+160=25020)
+        return self.vocab_size + self.sou_len + self.sub_token_len
+
+    def replace(self, **kw) -> "FiraConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# Named configs per BASELINE.json "configs".
+def fira_full(**kw) -> FiraConfig:
+    """Paper hyperparameters (reference run_model.py:30-46)."""
+    return FiraConfig(**kw)
+
+
+def fira_tiny(**kw) -> FiraConfig:
+    """2-layer GNN, d=64 — CPU smoke / overfit config."""
+    base = dict(
+        embedding_dim=64,
+        num_layers=2,
+        num_head=4,
+        sou_len=32,
+        tar_len=12,
+        att_len=6,
+        ast_change_len=24,
+        sub_token_len=24,
+        batch_size=16,
+        test_batch_size=8,
+        epochs=30,
+        dev_start_epoch=0,
+        dev_every_batches=4,
+        max_edges=512,
+    )
+    base.update(kw)
+    return FiraConfig(**base)
+
+
+def fira_large(**kw) -> FiraConfig:
+    """8-layer, d=512, beam-8 (BASELINE.json v4-32 config)."""
+    base = dict(
+        embedding_dim=512,
+        num_layers=8,
+        beam_size=8,
+        max_edges=8192,
+    )
+    base.update(kw)
+    return FiraConfig(**base)
+
+
+NAMED_CONFIGS = {
+    "fira-tiny": fira_tiny,
+    "fira-full": fira_full,
+    "fira-large": fira_large,
+}
+
+
+def get_config(name: str, **kw) -> FiraConfig:
+    if name not in NAMED_CONFIGS:
+        raise KeyError(f"unknown config {name!r}; choose from {sorted(NAMED_CONFIGS)}")
+    return NAMED_CONFIGS[name](**kw)
+
+
+def apply_ablation(cfg: FiraConfig, ablation: Optional[str]) -> FiraConfig:
+    """Map the paper's ablation names onto config switches.
+
+    no_edit     -> drop edit (change) nodes and their edges (Table 3 row 2)
+    no_subtoken -> drop the sub-token copy pointer span (Table 3 row 3)
+    nothing     -> both (Table 3 row 4)
+    """
+    if ablation in (None, "", "none", "full"):
+        return cfg
+    if ablation == "no_edit":
+        return cfg.replace(use_edit=False)
+    if ablation == "no_subtoken":
+        return cfg.replace(use_subtoken_copy=False)
+    if ablation == "nothing":
+        return cfg.replace(use_edit=False, use_subtoken_copy=False)
+    raise KeyError(f"unknown ablation {ablation!r}")

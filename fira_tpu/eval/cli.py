@@ -1,0 +1,46 @@
+"""Metric CLIs, drop-in equivalents of the reference Metrics/ scripts.
+
+Usage (mirrors /root/reference/README.md:44-52):
+    python -m fira_tpu.eval.cli bnorm   REF < HYP
+    python -m fira_tpu.eval.cli penalty REF < HYP
+    python -m fira_tpu.eval.cli rouge   -r REF -g HYP
+    python -m fira_tpu.eval.cli meteor  -r REF -g HYP
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from fira_tpu.eval import bnorm_bleu, meteor_files, penalty_bleu, rouge_l_files
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="fira-metrics")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_bnorm = sub.add_parser("bnorm", help="B-Norm BLEU (ref file, hyps on stdin)")
+    p_bnorm.add_argument("ref")
+    p_pen = sub.add_parser("penalty", help="Penalty-BLEU (ref file, hyps on stdin)")
+    p_pen.add_argument("ref")
+    for name in ("rouge", "meteor"):
+        p = sub.add_parser(name)
+        p.add_argument("-r", "--ref_path", required=True)
+        p.add_argument("-g", "--gen_path", required=True)
+
+    args = parser.parse_args(argv)
+    if args.cmd == "bnorm":
+        with open(args.ref) as rf:
+            print(bnorm_bleu(sys.stdin.readlines(), rf.readlines()))
+    elif args.cmd == "penalty":
+        with open(args.ref) as rf:
+            print(penalty_bleu(sys.stdin.readlines(), rf.readlines()))
+    elif args.cmd == "rouge":
+        print(rouge_l_files(args.gen_path, args.ref_path))
+    elif args.cmd == "meteor":
+        print(meteor_files(args.gen_path, args.ref_path))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

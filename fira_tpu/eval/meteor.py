@@ -1,0 +1,39 @@
+"""METEOR via NLTK, matching /root/reference/Metrics/Meteor.py:8-13:
+mean nltk meteor_score over line-paired files, x100.
+
+Modern NLTK requires pre-tokenized inputs (and the wordnet corpus); the
+reference ran an older NLTK that accepted raw strings and split internally.
+We pass ``.split()`` tokens, which is what old NLTK did with strings. If the
+wordnet corpus is unavailable (offline image), ``meteor`` raises a clear
+RuntimeError and callers should treat the metric as unavailable.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+
+def meteor(hyp_lines: Iterable[str], ref_lines: Iterable[str]) -> float:
+    try:
+        from nltk.translate.meteor_score import meteor_score
+    except Exception as e:  # pragma: no cover
+        raise RuntimeError(f"nltk unavailable for METEOR: {e}")
+
+    hyps = [h.rstrip("\n") for h in hyp_lines]
+    refs = [r.rstrip("\n") for r in ref_lines]
+    scores = []
+    try:
+        for ref, hyp in zip(refs, hyps):
+            scores.append(meteor_score([ref.split()], hyp.split()))
+    except LookupError as e:  # wordnet corpus missing
+        raise RuntimeError(f"METEOR needs the NLTK wordnet corpus: {e}")
+    if not scores:
+        return 0.0
+    return 100.0 * sum(scores) / len(scores)
+
+
+def meteor_files(hyp_path: str, ref_path: str) -> float:
+    # reference splits on "\n" (Meteor.py:9-10), pairing trailing empty strings
+    # too; zip() truncates to the shorter list the same way.
+    with open(hyp_path) as h, open(ref_path) as r:
+        return meteor(h.read().split("\n"), r.read().split("\n"))

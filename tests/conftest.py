@@ -1,0 +1,25 @@
+"""Test harness setup.
+
+All tests run on a virtual 8-device CPU mesh so multi-chip sharding
+(dp x tp over jax.sharding.Mesh) is exercised without TPU hardware, per the
+framework's multi-chip test strategy (SURVEY.md §4). Env vars must be set
+before jax initializes, hence this conftest — do not import jax above it.
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xf = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _xf:
+    os.environ["XLA_FLAGS"] = (_xf + " --xla_force_host_platform_device_count=8").strip()
+
+REFERENCE_ROOT = "/root/reference"
+
+
+def reference_available() -> bool:
+    return os.path.isdir(os.path.join(REFERENCE_ROOT, "OUTPUT"))

@@ -1,0 +1,84 @@
+"""Golden metric tests: pin the in-repo scorers to the frozen reference
+predictions (the only executable 'golden test' the reference ships —
+SURVEY.md §4.3). Expected values are the paper's Tables 1-3 numbers as
+re-computed locally with the reference's own Metrics/ scripts (BASELINE.md).
+"""
+
+import os
+import random
+
+import pytest
+
+from tests.conftest import REFERENCE_ROOT, reference_available
+from fira_tpu.eval import (
+    bnorm_bleu_files,
+    penalty_bleu_files,
+    rouge_l_files,
+    nltk_sentence_bleu,
+    sentence_bleu_method2,
+)
+
+OUT = os.path.join(REFERENCE_ROOT, "OUTPUT")
+needs_ref = pytest.mark.skipif(
+    not reference_available(), reason="reference OUTPUT/ not mounted"
+)
+
+GOLDEN_BNORM = [
+    ("output_fira", 17.666),          # paper Table 1: 17.67
+    ("output_fira_no_edit", 17.389),  # Table 3: 17.39
+    ("output_fira_no_subtoken", 17.362),  # Table 3: 17.36
+    ("output_fira_nothing", 16.823),  # Table 3: 16.82
+    ("output_nngen", 9.163),          # Table 1: 9.16
+    ("output_codisum", 16.552),       # Table 1: 16.55
+]
+
+
+@needs_ref
+@pytest.mark.parametrize("fname,expected", GOLDEN_BNORM)
+def test_bnorm_bleu_golden(fname, expected):
+    got = bnorm_bleu_files(os.path.join(OUT, fname), os.path.join(OUT, "ground_truth"))
+    assert abs(got - expected) < 5e-3, f"{fname}: {got} != {expected}"
+
+
+@needs_ref
+def test_penalty_bleu_golden():
+    got = penalty_bleu_files(
+        os.path.join(OUT, "output_fira"), os.path.join(OUT, "ground_truth")
+    )
+    # paper Table 2: 13.30; local recompute 13.299 (BASELINE.md)
+    assert abs(got - 13.299) < 5e-3, got
+
+
+@needs_ref
+def test_rouge_l_sanity():
+    # In-repo ROUGE-L (sumeval is unavailable; documented divergence risk).
+    # Paper Table 1 reports 21.58 for FIRA — require the same ballpark.
+    got = rouge_l_files(
+        os.path.join(OUT, "output_fira"), os.path.join(OUT, "ground_truth")
+    )
+    assert 19.0 < got < 24.0, got
+
+
+def test_rouge_identity():
+    from fira_tpu.eval import rouge_l
+
+    assert rouge_l(["fix npe in parser"], ["fix npe in parser"]) == pytest.approx(100.0)
+    assert rouge_l(["nothing shared"], ["totally different words here"]) == 0.0
+
+
+def test_method2_matches_nltk():
+    """In-repo method2 smoothing replication == real NLTK on random data."""
+    nltk = pytest.importorskip("nltk.translate.bleu_score")
+    smooth = nltk.SmoothingFunction().method2
+    rng = random.Random(0)
+    vocab = ["fix", "add", "remove", "npe", "parser", "test", "a", "the", "in"]
+    for _ in range(200):
+        ref = [rng.choice(vocab) for _ in range(rng.randint(1, 12))]
+        hyp = [rng.choice(vocab) for _ in range(rng.randint(1, 12))]
+        want = nltk.sentence_bleu([ref], hyp, smoothing_function=smooth)
+        got = sentence_bleu_method2([ref], hyp)
+        assert got == pytest.approx(want, abs=1e-12), (ref, hyp)
+
+
+def test_nltk_sentence_bleu_smoke():
+    assert nltk_sentence_bleu([["fix", "bug"]], ["fix", "bug"]) > 0.5
