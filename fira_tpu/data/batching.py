@@ -1,0 +1,83 @@
+"""Fixed-shape batch assembly for jitted TPU programs.
+
+Every batch has identical shapes (XLA compiles once): the final partial batch
+of an epoch is padded with zeroed samples whose labels are all <pad>, so they
+contribute nothing to the masked loss; a ``valid`` bool array marks real rows
+for eval bookkeeping. COO edges are padded per-sample to cfg.max_edges
+(pad entries scatter zero — a no-op on device).
+
+The reference instead ships a dense 650^2 float adjacency per sample through
+a torch DataLoader (Dataset.py:336-343) — the batching fix called out in
+SURVEY.md §7 hard-part 3.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from fira_tpu.config import FiraConfig
+from fira_tpu.data.dataset import ProcessedSplit, ARRAY_FIELDS
+
+Batch = Dict[str, np.ndarray]
+
+
+def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
+               batch_size: Optional[int] = None) -> Batch:
+    """Gather + pad a batch. ``indices`` may be shorter than batch_size."""
+    bs = batch_size or len(indices)
+    n_real = len(indices)
+    batch: Batch = {}
+    for f in ARRAY_FIELDS:
+        src = split.arrays[f][indices]
+        if n_real < bs:
+            pad = np.zeros((bs - n_real,) + src.shape[1:], dtype=src.dtype)
+            src = np.concatenate([src, pad])
+        batch[f] = src
+
+    senders = np.zeros((bs, cfg.max_edges), dtype=np.int32)
+    receivers = np.zeros((bs, cfg.max_edges), dtype=np.int32)
+    values = np.zeros((bs, cfg.max_edges), dtype=np.float32)
+    offsets = split.arrays["edge_offsets"]
+    for row, i in enumerate(indices):
+        lo, hi = offsets[i], offsets[i + 1]
+        n = hi - lo
+        if n > cfg.max_edges:
+            raise ValueError(f"sample {i}: {n} edges > max_edges={cfg.max_edges}")
+        senders[row, :n] = split.arrays["edge_senders"][lo:hi]
+        receivers[row, :n] = split.arrays["edge_receivers"][lo:hi]
+        values[row, :n] = split.arrays["edge_values"][lo:hi]
+    batch["senders"] = senders
+    batch["receivers"] = receivers
+    batch["values"] = values
+
+    valid = np.zeros(bs, dtype=bool)
+    valid[:n_real] = True
+    batch["valid"] = valid
+    return batch
+
+
+def epoch_batches(split: ProcessedSplit, cfg: FiraConfig, *,
+                  batch_size: Optional[int] = None,
+                  shuffle: bool = False,
+                  seed: int = 0,
+                  epoch: int = 0,
+                  drop_remainder: bool = False) -> Iterator[Batch]:
+    """One epoch of fixed-shape batches (shuffled like the reference's
+    DataLoader(shuffle=True), run_model.py:387). Pass the epoch number so
+    each epoch draws a fresh permutation (seed and epoch are folded together);
+    a fixed (seed, epoch) pair is fully deterministic."""
+    bs = batch_size or cfg.batch_size
+    order = np.arange(len(split))
+    if shuffle:
+        np.random.RandomState((seed * 1_000_003 + epoch) % (2**31)).shuffle(order)
+    for start in range(0, len(order), bs):
+        chunk = order[start : start + bs]
+        if drop_remainder and len(chunk) < bs:
+            return
+        yield make_batch(split, chunk, cfg, batch_size=bs)
+
+
+def num_batches(n: int, batch_size: int, drop_remainder: bool = False) -> int:
+    return n // batch_size if drop_remainder else (n + batch_size - 1) // batch_size

@@ -1,0 +1,160 @@
+"""Dataset pipeline tests: synthetic corpus roundtrip, processing postconditions,
+batching shapes, and a full differential test against the reference Dataset.py
+(imported from the read-only mount, run on the same synthetic corpus)."""
+
+import json
+import os
+import shutil
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from tests.conftest import REFERENCE_ROOT
+from fira_tpu.config import FiraConfig, fira_tiny
+from fira_tpu.data import synthetic
+from fira_tpu.data.batching import epoch_batches, make_batch
+from fira_tpu.data.dataset import FiraDataset, process_record
+from fira_tpu.data.vocab import CASE_PRESERVED_TOKENS, EOS_ID, START_ID, Vocab
+
+
+@pytest.fixture(scope="module")
+def corpus_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("corpus")
+    synthetic.write_corpus_dir(str(d), n_commits=40, seed=7)
+    return str(d)
+
+
+def test_case_preserved_tokens_match_reference():
+    path = os.path.join(REFERENCE_ROOT, "VOCAB_UPPER_CASE")
+    if not os.path.exists(path):
+        pytest.skip("reference not mounted")
+    ref = set(json.load(open(path)))
+    assert set(CASE_PRESERVED_TOKENS) == ref
+
+
+def test_corpus_roundtrip(corpus_dir):
+    from fira_tpu.data.schema import Corpus
+
+    corpus = Corpus.load(corpus_dir)
+    assert len(corpus) == 40
+    rec = corpus.record(0)
+    assert len(rec.diff_tokens) == len(rec.diff_marks) == len(rec.diff_atts)
+    assert rec.diff_tokens[0] == "<nb>"
+
+
+def test_process_record_postconditions(corpus_dir):
+    from fira_tpu.data.schema import Corpus
+
+    cfg = FiraConfig()
+    corpus = Corpus.load(corpus_dir)
+    wv = Vocab.from_json(os.path.join(corpus_dir, "word_vocab.json"))
+    av = Vocab.from_json(os.path.join(corpus_dir, "ast_change_vocab.json"))
+    for i in range(len(corpus)):
+        ex = process_record(corpus.record(i), wv, av, cfg)
+        assert ex.diff.shape == (cfg.sou_len,)
+        assert ex.msg.shape == (cfg.tar_len,)
+        assert ex.msg_tar.shape == (cfg.tar_len,)
+        assert ex.diff_mark.shape == (cfg.sou_len,)
+        assert ex.ast_change.shape == (cfg.ast_change_len,)
+        assert ex.sub_token.shape == (cfg.sub_token_len,)
+        assert ex.diff[0] == START_ID and ex.msg[0] == START_ID
+        assert EOS_ID in ex.msg
+        # copy labels stay inside the fused output distribution
+        assert ex.msg_tar.max() < len(wv) + cfg.sou_len + cfg.sub_token_len
+        # adjacency: self-loops guarantee >= graph_len edges
+        assert ex.senders.shape[0] >= cfg.graph_len
+
+
+def test_dataset_split_and_cache(corpus_dir):
+    cfg = FiraConfig(batch_size=8)
+    ds = FiraDataset(corpus_dir, cfg)
+    sizes = {s: len(ds.splits[s]) for s in ds.SPLITS}
+    assert sum(sizes.values()) == 40
+    assert sizes["train"] > sizes["valid"] >= 1
+    # cache round-trip: second construction loads without reprocessing
+    ds2 = FiraDataset(corpus_dir, cfg)
+    np.testing.assert_array_equal(
+        ds.splits["train"].arrays["diff"], ds2.splits["train"].arrays["diff"]
+    )
+
+
+def test_batching_fixed_shapes(corpus_dir):
+    cfg = FiraConfig(batch_size=8)
+    ds = FiraDataset(corpus_dir, cfg)
+    batches = list(epoch_batches(ds.splits["train"], ds.cfg, shuffle=True, seed=0))
+    n = len(ds.splits["train"])
+    assert len(batches) == (n + 7) // 8
+    for b in batches:
+        assert b["diff"].shape == (8, cfg.sou_len)
+        assert b["senders"].shape == (8, cfg.max_edges)
+        assert b["valid"].dtype == bool
+    # padded rows of the final partial batch have all-pad labels -> no loss
+    last = batches[-1]
+    n_real = int(last["valid"].sum())
+    if n_real < 8:
+        assert (last["msg_tar"][n_real:] == 0).all()
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_ROOT), reason="reference not mounted"
+)
+def test_differential_vs_reference_dataset(tmp_path, monkeypatch):
+    """Run the actual reference Dataset.py on our synthetic corpus and compare
+    every produced tensor (including the dense adjacency) with ours."""
+    torch = pytest.importorskip("torch")
+
+    # corpus in the reference's expected layout, relative to cwd
+    data_dir = tmp_path / "DataSet"
+    synthetic.write_corpus_dir(str(data_dir), n_commits=30, seed=3)
+    (tmp_path / "VOCAB_UPPER_CASE").write_text(
+        json.dumps(sorted(CASE_PRESERVED_TOKENS))
+    )
+    monkeypatch.chdir(tmp_path)
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ref_dataset", os.path.join(REFERENCE_ROOT, "Dataset.py")
+    )
+    ref_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ref_mod)
+    ref_mod.num_train, ref_mod.num_valid, ref_mod.num_test = 20, 5, 5
+
+    args = SimpleNamespace(
+        sou_len=210, tar_len=30, att_len=25, ast_change_len=280,
+        sub_token_len=160,
+    )
+    ref_train = ref_mod.TransDataset(args, "train")
+
+    # our pipeline on the same directory, honoring the reference's split file
+    shutil.copy(tmp_path / "all_index", data_dir / "all_index")
+    cfg = FiraConfig()
+    ds = FiraDataset(str(data_dir), cfg)
+
+    field_order = ["diff", "msg", None, "diff_mark", "ast_change", None,
+                   "msg_tar", "sub_token"]  # reference batch slots 0..7
+    for split_name in ("train", "valid", "test"):
+        ref_batches = __import__("pickle").load(
+            open(f"processed_{split_name}.pkl", "rb")
+        )
+        ours = ds.splits[split_name]
+        n = len(ours)
+        assert len(ref_batches[0]) == n
+        for slot, field in enumerate(field_order):
+            if field is None:
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(ref_batches[slot]), ours.arrays[field],
+                err_msg=f"{split_name}/{field}",
+            )
+        for i in range(n):
+            ref_dense = ref_batches[5][i].toarray()
+            s, r, v = ours.edge_slice(i)
+            got = np.zeros((cfg.graph_len, cfg.graph_len), dtype=np.float64)
+            got[s, r] = v
+            np.testing.assert_allclose(
+                got, ref_dense, atol=1e-6,
+                err_msg=f"{split_name} adjacency {i}",
+            )

@@ -1,0 +1,155 @@
+"""Unit tests for graph assembly — each encodes a Dataset.py invariant
+(SURVEY.md §4 'the invariants are the free spec')."""
+
+import numpy as np
+import pytest
+
+from fira_tpu.data import graph_build as gb
+
+# a small geometry: sou=10, sub=6, ast_change=8 -> graph_len 24
+GEOM = dict(sou_len=10, sub_token_len=6, ast_change_len=8)
+GRAPH_LEN = 24
+
+
+def build(**kw):
+    args = dict(
+        raw_diff_len=4,
+        n_ast=2,
+        edge_change_code=[],
+        edge_change_ast=[],
+        edge_ast_code=[],
+        edge_ast=[],
+        edge_sub_token=[],
+        use_edit=True,
+        **GEOM,
+    )
+    args.update(kw)
+    return gb.build_adjacency(**args)
+
+
+class TestDedupSubTokens:
+    def test_basic_and_dedup(self):
+        # Dataset.py:173-196: repeated token reuses nodes, adds edges
+        diff = ["getName", "(", "getName", "setVal", ")"]
+        atts = [["get", "name"], [], ["get", "name"], ["set", "val"], []]
+        subs, edges = gb.dedup_sub_tokens(diff, atts)
+        assert subs == ["get", "name", "set", "val"]
+        assert edges == [(0, 0), (0, 1), (2, 0), (2, 1), (3, 2), (3, 3)]
+
+    def test_conflicting_atts_raise(self):
+        with pytest.raises(gb.GraphBuildError):
+            gb.dedup_sub_tokens(["a", "a"], [["x"], ["y"]])
+
+
+class TestCopyLabels:
+    def test_diff_copy_has_start_shift(self):
+        # Dataset.py:202: label = diff.index(tok) + vocab_size + 1
+        labels = gb.copy_labels(
+            [7], ["foo"], ["x", "foo"], [], vocab_size=100, sou_len=10
+        )
+        assert labels == [100 + 1 + 1]
+
+    def test_subtoken_copy_no_shift(self):
+        # Dataset.py:213: label = sub.index(tok) + vocab_size + sou_len
+        labels = gb.copy_labels(
+            [7], ["foo"], ["x"], ["bar", "foo"], vocab_size=100, sou_len=10
+        )
+        assert labels == [100 + 10 + 1]
+
+    def test_diff_precedence_over_subtoken(self):
+        # Dataset.py:210-211: an already-copied position is not overwritten
+        labels = gb.copy_labels(
+            [7], ["foo"], ["foo"], ["foo"], vocab_size=100, sou_len=10
+        )
+        assert labels == [100 + 0 + 1]
+
+    def test_no_subtoken_ablation(self):
+        labels = gb.copy_labels(
+            [7], ["foo"], ["x"], ["foo"], vocab_size=100, sou_len=10,
+            use_subtoken_copy=False,
+        )
+        assert labels == [7]
+
+    def test_first_occurrence_wins(self):
+        labels = gb.copy_labels(
+            [7], ["foo"], ["foo", "foo"], [], vocab_size=100, sou_len=10
+        )
+        assert labels == [101]
+
+
+class TestAdjacency:
+    def test_self_loops_and_sequential(self):
+        adj = build()
+        dense = adj.to_dense(GRAPH_LEN)
+        # every node has a self-loop (Dataset.py:271-275)
+        assert (np.diag(dense) > 0).all()
+        # sequential chain covers raw_diff_len+2 positions, symmetric
+        for j in range(4 + 1):
+            assert dense[j, j + 1] > 0 and dense[j + 1, j] > 0
+        assert dense[5, 6] == 0  # chain stops at len(raw_diff)+1
+
+    def test_family_offsets(self):
+        # hand-computed global coordinates for each family
+        adj = build(
+            n_ast=2,
+            edge_change_code=[(0, 2)],   # -> (16+2+0, 3)  [change_base=16+2=18]
+            edge_change_ast=[(1, 0)],    # -> (19, 16)
+            edge_ast_code=[(1, 0)],      # -> (17, 1)
+            edge_ast=[(0, 1)],           # -> (16, 17)
+            edge_sub_token=[(3, 2)],     # -> (4, 12)
+        )
+        dense = adj.to_dense(GRAPH_LEN)
+        for r, c in [(18, 3), (19, 16), (17, 1), (16, 17), (4, 12)]:
+            assert dense[r, c] > 0 and dense[c, r] > 0, (r, c)
+
+    def test_code_skip_rule(self):
+        # Dataset.py:228,243: p2 = j+1 >= sou_len drops change/ast->code edges
+        adj = build(edge_change_code=[(0, 9)], edge_ast_code=[(0, 9)])
+        dense = adj.to_dense(GRAPH_LEN)
+        assert dense[18, 9 + 1].item() == 0  # would be p2=10 >= sou_len
+        # but j=8 -> p2=9 survives
+        adj2 = build(edge_ast_code=[(0, 8)])
+        assert adj2.to_dense(GRAPH_LEN)[16, 9] > 0
+
+    def test_degree_normalization(self):
+        # Dataset.py:277-291: value = 1/sqrt(deg_row)/sqrt(deg_col) over the
+        # deduplicated self-looped multiset; verify against a dense recompute.
+        adj = build(edge_ast=[(0, 1)], edge_sub_token=[(0, 0), (1, 0)])
+        dense = adj.to_dense(GRAPH_LEN)
+        unnorm = (dense > 0).astype(np.float64)
+        deg = unnorm.sum(axis=1, keepdims=True)  # symmetric: row deg == col deg
+        expected = unnorm / np.sqrt(deg) / np.sqrt(deg.T)
+        np.testing.assert_allclose(dense, expected, rtol=1e-6)
+
+    def test_duplicate_edges_inserted_once(self):
+        a1 = build(edge_ast=[(0, 1)])
+        a2 = build(edge_ast=[(0, 1), (0, 1), (1, 0)])
+        np.testing.assert_array_equal(
+            a1.to_dense(GRAPH_LEN), a2.to_dense(GRAPH_LEN)
+        )
+
+    def test_no_edit_ablation_drops_change_families(self):
+        adj = build(
+            edge_change_code=[(0, 2)], edge_change_ast=[(0, 0)],
+            edge_ast=[(0, 1)], use_edit=False,
+        )
+        dense = adj.to_dense(GRAPH_LEN)
+        assert dense[18, 3] == 0 and dense[18, 16] == 0
+        assert dense[16, 17] > 0  # non-change families remain
+
+    def test_out_of_range_raises(self):
+        with pytest.raises(gb.GraphBuildError):
+            build(edge_ast=[(0, 50)])
+
+    def test_explicit_self_edge_raises(self):
+        with pytest.raises(gb.GraphBuildError):
+            build(edge_ast=[(0, 0)])
+
+    def test_copy_label_overflow_raises(self):
+        # diff index 20 -> label 100+21 lands beyond vocab+sou+sub = 100+10+6
+        long_diff = ["x"] * 20 + ["foo"]
+        with pytest.raises(gb.GraphBuildError):
+            gb.copy_labels(
+                [7], ["foo"], long_diff, [], vocab_size=100, sou_len=10,
+                sub_token_len=6,
+            )
