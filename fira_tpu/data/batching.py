@@ -28,6 +28,8 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
     """Gather + pad a batch. ``indices`` may be shorter than batch_size."""
     bs = batch_size or len(indices)
     n_real = len(indices)
+    if n_real > bs:
+        raise ValueError(f"{n_real} indices exceed batch_size={bs}")
     batch: Batch = {}
     for f in ARRAY_FIELDS:
         src = split.arrays[f][indices]

@@ -4,9 +4,10 @@ pipeline, rebuilt around COO edge lists and ragged caching).
 Per-commit processing order follows the reference exactly:
 variable-placeholder substitution -> case normalization -> lemmatization (msg
 only) -> id conversion -> <start>/<eos> wrapping -> padding -> sub-token dedup
--> copy labels -> adjacency assembly. Examples cache to a single .npz per
-split with ragged edge storage (concatenated COO + offsets) instead of 90k
-scipy matrices pickled (Dataset.py:294,332) — loading is one mmap-able read.
+-> copy labels -> adjacency assembly. Examples cache to a single compressed
+.npz per split with ragged edge storage (concatenated COO + offsets) instead
+of 90k scipy matrices pickled (Dataset.py:294,332) — one sequential read, a
+fraction of the pickle's size.
 """
 
 from __future__ import annotations
@@ -205,7 +206,8 @@ class FiraDataset:
     def _load_or_draw_split(self, corpus: Optional[Corpus]) -> Dict[str, List[int]]:
         path = os.path.join(self.data_dir, SPLIT_INDEX_FILE)
         if os.path.exists(path):
-            return json.load(open(path))
+            with open(path) as f:
+                return json.load(f)
         corpus = corpus or Corpus.load(self.data_dir)
         n = len(corpus)
         # reference proportions 75000/8000/7661 of 90661 (Dataset.py:10-12)
@@ -219,7 +221,8 @@ class FiraDataset:
             "valid": index[n_train : n_train + n_valid],
             "test": index[n_train + n_valid :],
         }
-        json.dump(split, open(path, "w"))
+        with open(path, "w") as f:
+            json.dump(split, f)
         return split
 
     # --- processing / caching ---

@@ -59,6 +59,15 @@ def dedup_sub_tokens(
     for j, att in enumerate(diff_atts):
         if not att:
             continue
+        for part in att:
+            # crash parity with Dataset.py:148-151: a non-lowercase sub-token
+            # would silently miss copy-label matches against normalized
+            # message tokens, so fail loudly like the reference does.
+            if not part.islower():
+                raise GraphBuildError(
+                    f"sub-token {part!r} of token {diff_tokens[j]!r} is not "
+                    f"lower-case"
+                )
         token = diff_tokens[j]
         if token in seen:
             existing = [sub_tokens[k] for k in seen[token]]

@@ -156,16 +156,15 @@ def generate_corpus(n_commits: int, seed: int = 0) -> Corpus:
 def build_vocabs(corpus: Corpus, min_freq: int = 1) -> Tuple[Vocab, Vocab]:
     """Word + ast/change vocabs over the processed token space (substituted,
     case-normalized, lemmatized), mirroring what the reference ships."""
+    from fira_tpu.data.dataset import _substitute
+
     word_streams = []
     for i in range(len(corpus)):
         var_map = corpus.streams["variable"][i]
-        diff = [
-            normalize_token(var_map.get(t, t)) for t in corpus.streams["difftoken"][i]
-        ]
+        diff = _substitute(corpus.streams["difftoken"][i], var_map)
         msg = [
-            LEMMATIZATION.get(normalize_token(var_map.get(t, t)),
-                              normalize_token(var_map.get(t, t)))
-            for t in corpus.streams["msg"][i]
+            LEMMATIZATION.get(t, t)
+            for t in _substitute(corpus.streams["msg"][i], var_map)
         ]
         subs = [p for att in corpus.streams["diffatt"][i] for p in att]
         word_streams.extend([diff, msg, subs])

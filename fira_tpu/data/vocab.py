@@ -106,10 +106,12 @@ class Vocab:
 
     @classmethod
     def from_json(cls, path: str) -> "Vocab":
-        return cls(json.load(open(path)))
+        with open(path) as f:
+            return cls(json.load(f))
 
     def to_json(self, path: str) -> None:
-        json.dump(self.token_to_id, open(path, "w"), indent=1)
+        with open(path, "w") as f:
+            json.dump(self.token_to_id, f, indent=1)
 
     @classmethod
     def build_word_vocab(

@@ -1,0 +1,197 @@
+"""Building-block Flax modules for the FIRA graph encoder / decoder.
+
+Each module is a TPU-first rebuild of a reference layer (cited per class from
+/root/reference/gnn_transformer.py and combination_layer.py), matching the
+live math exactly — post-LN residuals, dropout sites (0.2 in the GCN, 0.1
+elsewhere), additive -1e9 masking, interleaved sin/cos positions — while
+omitting the reference's dead modules (lstm, combination_list1, gate_fc;
+SURVEY.md Appendix B).
+
+Initializers mirror PyTorch defaults so training dynamics are comparable:
+Linear weights ~ U(+-1/sqrt(fan_in)) (kaiming_uniform with a=sqrt(5)),
+Linear biases ~ U(+-1/sqrt(fan_in)), Embedding ~ N(0,1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+# torch nn.Linear default: kaiming_uniform(a=sqrt(5)) == U(+-sqrt(1/fan_in))
+torch_kernel_init = nn.initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+torch_embed_init = nn.initializers.normal(stddev=1.0)
+
+
+def stable_dtype(dtype):
+    """Numerics-sensitive ops (LayerNorm, softmax, log) run in at least
+    float32: bf16 compute promotes to f32, f64 (parity testing) stays f64."""
+    return jnp.promote_types(dtype, jnp.float32)
+
+
+def torch_bias_init(key, shape, dtype, fan_in: int):
+    bound = 1.0 / np.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+class TorchDense(nn.Module):
+    """nn.Dense with PyTorch nn.Linear default initialization."""
+
+    features: int
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        fan_in = x.shape[-1]
+        kernel = self.param(
+            "kernel", torch_kernel_init, (fan_in, self.features), jnp.float32
+        )
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                lambda k, s, d: torch_bias_init(k, s, d, fan_in),
+                (self.features,),
+                jnp.float32,
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+def position_encoding(length: int, dmodel: int) -> np.ndarray:
+    """Interleaved sin/cos positions (gnn_transformer.py:10-19): for each
+    frequency j the pair (sin, cos) is laid out adjacently — NOT the usual
+    all-sin-then-all-cos layout."""
+    pos = np.zeros((length, dmodel), dtype=np.float32)
+    i = np.arange(length)[:, None].astype(np.float64)
+    j = np.arange(dmodel // 2)[None, :].astype(np.float64)
+    angle = i / np.power(10000.0, 2.0 * j / dmodel)
+    pos[:, 0::2] = np.sin(angle)
+    pos[:, 1::2] = np.cos(angle)
+    return pos
+
+
+def combination_gate(query, key, value, *, dropout=None):
+    """combination_layer.py:6-17: attention-free two-channel gating.
+
+    Per element: weights = softmax over the pair (q*k/sqrt(d), q*v/sqrt(d));
+    output = w0*k + w1*v, then dropout. Used to fuse token vs. diff-mark
+    channels.
+    """
+    scale = 1.0 / np.sqrt(query.shape[-1])
+    qk = query * key * scale
+    qv = query * value * scale
+    w = jax.nn.softmax(jnp.stack([qk, qv], axis=-1), axis=-1)
+    out = w[..., 0] * key + w[..., 1] * value
+    if dropout is not None:
+        out = dropout(out)
+    return out
+
+
+class Combination(nn.Module):
+    """Multi-head wrapper around the combination gate
+    (gnn_transformer.py:176-205): three input projections, per-head gating,
+    output projection, post-LN residual on the query. Dropout is applied both
+    inside the gate and after the output projection, as the reference does.
+    """
+
+    num_heads: int
+    d_model: int
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key, value, *, deterministic: bool):
+        old_query = query
+        B = query.shape[0]
+        d_head = self.d_model // self.num_heads
+
+        def split_heads(x):
+            return x.reshape(B, -1, self.num_heads, d_head).transpose(0, 2, 1, 3)
+
+        q = split_heads(TorchDense(self.d_model, dtype=self.dtype, name="q_proj")(query))
+        k = split_heads(TorchDense(self.d_model, dtype=self.dtype, name="k_proj")(key))
+        v = split_heads(TorchDense(self.d_model, dtype=self.dtype, name="v_proj")(value))
+
+        inner_dropout = nn.Dropout(self.dropout_rate, deterministic=deterministic)
+        x = combination_gate(q, k, v, dropout=inner_dropout)
+        x = x.transpose(0, 2, 1, 3).reshape(B, -1, self.d_model)
+        out = TorchDense(self.d_model, dtype=self.dtype, name="out_proj")(x)
+        out = nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)
+        return nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype), name="norm")(out + old_query)
+
+
+class GCN(nn.Module):
+    """One graph-convolution round (gnn_transformer.py:64-86):
+    fc1 -> A.x -> fc2 -> dropout(0.2) + residual -> LayerNorm, over the
+    shared normalized adjacency. The adjacency arrives dense per batch
+    (scattered once per step from COO) so the message passing is a single
+    MXU-friendly bmm."""
+
+    d_model: int
+    dropout_rate: float = 0.2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, graph_em, adj, *, deterministic: bool):
+        x = TorchDense(self.d_model, dtype=self.dtype, name="fc1")(graph_em)
+        x = jnp.einsum("bij,bjd->bid", adj.astype(self.dtype), x)
+        x = TorchDense(self.d_model, dtype=self.dtype, name="fc2")(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
+        return nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype), name="norm")(x + graph_em)
+
+
+class Attention(nn.Module):
+    """Post-LN multi-head attention (gnn_transformer.py:124-161): additive
+    -1e9 masking where mask==0, softmax, output projection, dropout, residual
+    on the ORIGINAL query, LayerNorm."""
+
+    num_heads: int
+    d_model: int
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key, value, mask, *, deterministic: bool):
+        old_query = query
+        B, q_len = query.shape[0], query.shape[1]
+        kv_len = key.shape[1]
+        d_head = self.d_model // self.num_heads
+
+        q = TorchDense(self.d_model, dtype=self.dtype, name="q_proj")(query)
+        k = TorchDense(self.d_model, dtype=self.dtype, name="k_proj")(key)
+        v = TorchDense(self.d_model, dtype=self.dtype, name="v_proj")(value)
+        q = q.reshape(B, q_len, self.num_heads, d_head).transpose(0, 2, 1, 3)
+        k = k.reshape(B, kv_len, self.num_heads, d_head).transpose(0, 2, 1, 3)
+        v = v.reshape(B, kv_len, self.num_heads, d_head).transpose(0, 2, 1, 3)
+
+        weight = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d_head)
+        if mask.ndim < 4:  # (B, kv_len) key-padding mask -> (B,1,1,kv)
+            mask = mask[:, None, None, :]
+        weight = jnp.where(mask == 0, jnp.asarray(-1e9, weight.dtype), weight)
+        weight = jax.nn.softmax(weight.astype(stable_dtype(self.dtype)), axis=-1).astype(self.dtype)
+
+        out = jnp.einsum("bhqk,bhkd->bhqd", weight, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, q_len, self.d_model)
+        out = TorchDense(self.d_model, dtype=self.dtype, name="out_proj")(out)
+        out = nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)
+        return nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype), name="norm")(out + old_query)
+
+
+class FeedForward(nn.Module):
+    """Post-LN 4x ReLU FFN (gnn_transformer.py:163-174)."""
+
+    d_model: int
+    mult: int = 4
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool):
+        h = TorchDense(self.mult * self.d_model, dtype=self.dtype, name="fc1")(x)
+        h = jax.nn.relu(h)
+        h = TorchDense(self.d_model, dtype=self.dtype, name="fc2")(h)
+        h = nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)
+        return nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype), name="norm")(h + x)

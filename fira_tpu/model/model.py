@@ -1,0 +1,247 @@
+"""FIRA model: GCN graph encoder + Transformer decoder + dual copy head.
+
+TPU-first rebuild of /root/reference/Model.py and gnn_transformer.py. The
+whole forward is one jittable program over fixed shapes: the COO adjacency is
+scattered to a dense (B, graph_len, graph_len) once per call and reused by
+all GCN rounds; everything else is batched matmuls on the MXU.
+
+Live-path math matches the reference exactly (parity-tested by weight
+transplant in tests/test_model_parity.py); the dead modules (Encoder.lstm,
+combination_list1, TransModel.gate_fc, the attr input) are omitted
+(SURVEY.md Appendix B).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from fira_tpu.config import FiraConfig
+from fira_tpu.model.layers import (
+    stable_dtype,
+    Attention,
+    Combination,
+    FeedForward,
+    GCN,
+    TorchDense,
+    position_encoding,
+    torch_embed_init,
+)
+
+
+def dense_adjacency(senders, receivers, values, graph_len: int) -> jnp.ndarray:
+    """Scatter padded COO triplets into a dense batched adjacency.
+
+    Pad entries are (0, 0, 0.0); scatter-ADD of zero is a no-op, so no
+    masking is needed. Replaces the reference's host-side per-sample densify
+    (Dataset.py:336-343) with one on-device scatter per step.
+    """
+    B, _ = senders.shape
+    adj = jnp.zeros((B, graph_len, graph_len), dtype=values.dtype)
+    b_idx = jnp.arange(B)[:, None]
+    return adj.at[b_idx, senders, receivers].add(values)
+
+
+class Encoder(nn.Module):
+    """gnn_transformer.py:21-62: embeddings + 6 rounds of
+    {mark-fusion Combination -> concat [diff || sub || ast_change] -> GCN}."""
+
+    cfg: FiraConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, diff, mark, ast_change, adj, sub_token,
+                 *, deterministic: bool):
+        cfg = self.cfg
+        word_embed = nn.Embed(
+            cfg.vocab_size, cfg.embedding_dim,
+            embedding_init=torch_embed_init, dtype=self.dtype, name="word_embed",
+        )
+        mark_embed = nn.Embed(
+            4, cfg.embedding_dim,
+            embedding_init=torch_embed_init, dtype=self.dtype, name="mark_embed",
+        )
+        ast_change_embed = nn.Embed(
+            cfg.ast_change_vocab_size, cfg.embedding_dim,
+            embedding_init=torch_embed_init, dtype=self.dtype,
+            name="ast_change_embed",
+        )
+
+        # padding_idx=0 semantics (gnn_transformer.py:32-39): pad rows
+        # contribute exactly zero.
+        def embed_padded(table, ids):
+            return table(ids) * (ids != 0)[..., None].astype(self.dtype)
+
+        pos = jnp.asarray(position_encoding(cfg.sou_len, cfg.embedding_dim),
+                          dtype=self.dtype)
+        input_em = embed_padded(word_embed, diff) + pos[None, :, :]
+        mark_em = embed_padded(mark_embed, mark)
+        ast_change_em = embed_padded(ast_change_embed, ast_change)
+        sub_token_em = embed_padded(word_embed, sub_token)
+
+        for i in range(cfg.num_layers):
+            input_em = Combination(
+                num_heads=cfg.num_head, d_model=cfg.embedding_dim,
+                dropout_rate=cfg.dropout_rate, dtype=self.dtype,
+                name=f"combination_{i}",
+            )(input_em, input_em, mark_em, deterministic=deterministic)
+            graph_em = jnp.concatenate([input_em, sub_token_em, ast_change_em],
+                                       axis=1)
+            graph_em = GCN(
+                d_model=cfg.embedding_dim, dropout_rate=cfg.gcn_dropout_rate,
+                dtype=self.dtype, name=f"gcn_{i}",
+            )(graph_em, adj, deterministic=deterministic)
+            input_em = graph_em[:, : cfg.sou_len]
+            sub_token_em = graph_em[:, cfg.sou_len : cfg.sou_len + cfg.sub_token_len]
+            ast_change_em = graph_em[:, cfg.sou_len + cfg.sub_token_len :]
+
+        return input_em, sub_token_em
+
+
+class Decoder(nn.Module):
+    """gnn_transformer.py:88-122: 6 x {causal self-attn, cross-attn over
+    [diff || sub-token] encoder states, FFN}, all post-LN."""
+
+    cfg: FiraConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tar, sou_embedding, sou_mask, tar_mask_pad,
+                 *, deterministic: bool):
+        cfg = self.cfg
+        # no padding_idx on the decoder embedding (gnn_transformer.py:93-94)
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.embedding_dim,
+            embedding_init=torch_embed_init, dtype=self.dtype, name="embed",
+        )
+        T = tar.shape[1]
+        pos = jnp.asarray(position_encoding(cfg.tar_len, cfg.embedding_dim),
+                          dtype=self.dtype)
+        x = embed(tar) + pos[None, :T, :]
+
+        causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+        # (B,1,1,T) pad mask AND (1,1,T,T) causal (gnn_transformer.py:117)
+        tar_mask = tar_mask_pad[:, None, None, :] & causal[None, None, :, :]
+
+        for i in range(cfg.num_layers):
+            x = Attention(
+                num_heads=cfg.num_head, d_model=cfg.embedding_dim,
+                dropout_rate=cfg.dropout_rate, dtype=self.dtype,
+                name=f"self_attn_{i}",
+            )(x, x, x, tar_mask, deterministic=deterministic)
+            x = Attention(
+                num_heads=cfg.num_head, d_model=cfg.embedding_dim,
+                dropout_rate=cfg.dropout_rate, dtype=self.dtype,
+                name=f"cross_attn_{i}",
+            )(x, sou_embedding, sou_embedding, sou_mask, deterministic=deterministic)
+            x = FeedForward(
+                d_model=cfg.embedding_dim, mult=cfg.ffn_mult,
+                dropout_rate=cfg.dropout_rate, dtype=self.dtype,
+                name=f"ffn_{i}",
+            )(x, deterministic=deterministic)
+        return x
+
+
+class CopyNet(nn.Module):
+    """Model.py:7-20: Bahdanau-style pointer scores over source positions
+    plus a 2-way generate/copy gate."""
+
+    d_model: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, source, target):
+        src = TorchDense(self.d_model, use_bias=False, dtype=self.dtype,
+                         name="src_proj")(source)     # (B,S,D)
+        tgt = TorchDense(self.d_model, use_bias=False, dtype=self.dtype,
+                         name="tgt_proj")(target)     # (B,T,D)
+        # (B,T,S,D) additive interaction; the big intermediate is recomputed
+        # in the backward pass instead of stored (jax.checkpoint at call site).
+        inter = jnp.tanh(src[:, None, :, :] + tgt[:, :, None, :])
+        scores = TorchDense(1, dtype=self.dtype, name="score")(inter)[..., 0]
+        gate = jax.nn.softmax(
+            TorchDense(2, dtype=self.dtype, name="gate")(target).astype(
+                stable_dtype(self.dtype)
+            ),
+            axis=-1,
+        )
+        return scores, gate
+
+
+class FiraModel(nn.Module):
+    """Model.py:24-86: encoder + decoder + fused gen/copy distribution."""
+
+    cfg: FiraConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.cfg
+        self.encoder = Encoder(cfg, dtype=self.dtype)
+        self.decoder = Decoder(cfg, dtype=self.dtype)
+        self.copy_net = CopyNet(cfg.embedding_dim, dtype=self.dtype)
+        self.out_fc = TorchDense(cfg.vocab_size, dtype=self.dtype)
+
+    def encode(self, batch: Dict[str, jnp.ndarray], *,
+               deterministic: bool = True):
+        """Run the graph encoder once; returns ([diff||sub] states, mask)."""
+        cfg = self.cfg
+        adj = dense_adjacency(
+            batch["senders"], batch["receivers"], batch["values"], cfg.graph_len
+        )
+        sou_mask = batch["diff"] != 0
+        sub_mask = batch["sub_token"] != 0
+        sou_emb, sub_emb = self.encoder(
+            batch["diff"], batch["diff_mark"], batch["ast_change"], adj,
+            batch["sub_token"], deterministic=deterministic,
+        )
+        states = jnp.concatenate([sou_emb, sub_emb], axis=1)
+        mask = jnp.concatenate([sou_mask, sub_mask], axis=1)
+        return states, mask
+
+    def fused_log_probs(self, states, mask, tar, tar_mask_pad, *,
+                        deterministic: bool = True):
+        """Decoder + copy fusion -> log distribution over
+        vocab_size + sou_len + sub_token_len (Model.py:52-69)."""
+        tar_emb = self.decoder(tar, states, mask, tar_mask_pad,
+                               deterministic=deterministic)
+        gen = jax.nn.softmax(
+            self.out_fc(tar_emb).astype(stable_dtype(self.dtype)), axis=-1
+        )
+        scores, gate = self.copy_net(states, tar_emb)
+        scores = jnp.where(mask[:, None, :], scores, jnp.asarray(-1e9, scores.dtype))
+        copy = jax.nn.softmax(scores.astype(stable_dtype(self.dtype)), axis=-1)
+        fused = jnp.concatenate(
+            [gate[:, :, 0:1] * gen, gate[:, :, 1:2] * copy], axis=-1
+        )
+        return jnp.log(jnp.clip(fused, 1e-10, 1.0))
+
+    def __call__(self, batch: Dict[str, jnp.ndarray], *,
+                 deterministic: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Training/dev forward. Returns (loss_sum, token_count) like the
+        reference (Model.py:83-84); callers normalize (run_model.py:105)."""
+        states, mask = self.encode(batch, deterministic=deterministic)
+        tar = batch["msg"]
+        log_probs = self.fused_log_probs(
+            states, mask, tar, tar != 0, deterministic=deterministic
+        )
+        # label = tar_label shifted left with a zero column (Model.py:71-79)
+        label = jnp.concatenate(
+            [batch["msg_tar"][:, 1:],
+             jnp.zeros((tar.shape[0], 1), dtype=batch["msg_tar"].dtype)],
+            axis=1,
+        )
+        label_mask = label != 0
+        nll = -jnp.take_along_axis(log_probs, label[..., None], axis=-1)[..., 0]
+        nll = jnp.where(label_mask, nll, 0.0)
+        return nll.sum(), label_mask.sum()
+
+    def dev_predict(self, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        """Teacher-forced greedy ids for all positions at once (Model.py:86)."""
+        states, mask = self.encode(batch, deterministic=True)
+        tar = batch["msg"]
+        log_probs = self.fused_log_probs(states, mask, tar, tar != 0)
+        return jnp.argmax(log_probs, axis=-1)

@@ -13,10 +13,35 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the harness env pins 'axon'
 _xf = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _xf:
     os.environ["XLA_FLAGS"] = (_xf + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _force_cpu_backend():
+    """Make tests immune to the TPU tunnel ('axon' PJRT plugin).
+
+    The sandbox registers the axon plugin in every interpreter via
+    sitecustomize and pins JAX_PLATFORMS=axon; jax.backends() then eagerly
+    dials the tunnel even for CPU work, and hangs indefinitely when the
+    tunnel is down. Deregistering the factory before the first backends()
+    call keeps the whole test session on the virtual 8-device CPU mesh.
+    """
+    try:
+        from jax._src import xla_bridge as xb
+
+        for name in list(getattr(xb, "_backend_factories", {})):
+            if name != "cpu":
+                del xb._backend_factories[name]
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # older/newer jax layouts: fall back to env vars alone
+
+
+_force_cpu_backend()
 
 REFERENCE_ROOT = "/root/reference"
 
