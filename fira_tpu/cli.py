@@ -1,0 +1,169 @@
+"""Command-line driver.
+
+Keeps the reference's surface — ``train`` / ``test`` positionals
+(/root/reference/run_model.py:417-425) — and adds the real flag system the
+reference lacks (SURVEY.md §5 "Config / flag system"): named configs
+(fira-tiny / fira-full / fira-large), ablation switches matching the paper's
+Table 3 rows, a --backend flag (jax is the only compiled-in backend; the
+flag exists for CLI parity with torch-based stacks), mesh shape, data/output
+directories, and resume control.
+
+Examples:
+    python -m fira_tpu.cli train --data-dir DataSet --config fira-full
+    python -m fira_tpu.cli test  --data-dir DataSet --ablation no_edit
+    python -m fira_tpu.cli train --config fira-tiny --synthetic 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="fira_tpu", description=__doc__)
+    p.add_argument("command", choices=["train", "test", "preprocess"],
+                   help="train: fit + dev-gate; test: beam-decode the test "
+                        "split; preprocess: raw diffs -> DataSet/ corpus")
+    p.add_argument("--backend", default="jax", choices=["jax"],
+                   help="compute backend (this framework is TPU/JAX-native)")
+    p.add_argument("--config", default="fira-full",
+                   help="named config: fira-tiny | fira-full | fira-large")
+    p.add_argument("--ablation", default=None,
+                   choices=[None, "no_edit", "no_subtoken", "nothing"],
+                   help="paper Table 3 ablations")
+    p.add_argument("--data-dir", default="DataSet",
+                   help="corpus directory (reference DataSet/ layout)")
+    p.add_argument("--out-dir", default="OUTPUT")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="default: <out-dir>/ckpt[_<ablation>]")
+    p.add_argument("--epochs", type=int, default=None,
+                   help="override config epoch count")
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--no-resume", action="store_true",
+                   help="ignore an existing latest checkpoint")
+    p.add_argument("--synthetic", type=int, default=None, metavar="N",
+                   help="generate an N-commit synthetic corpus into "
+                        "--data-dir first (fixture / smoke runs)")
+    p.add_argument("--mesh", default=None, metavar="DPxTP",
+                   help="device mesh, e.g. 4x1 (data x model); default: all "
+                        "devices on the data axis")
+    p.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"],
+                   help="compute dtype override (params stay f32)")
+    p.add_argument("--beam-log-space", action="store_true",
+                   help="log-space beam accumulation instead of the "
+                        "reference-compat probability space")
+    return p
+
+
+def _resolve_cfg(args):
+    from fira_tpu.config import apply_ablation, get_config
+
+    cfg = get_config(args.config.replace("_", "-"))
+    cfg = apply_ablation(cfg, args.ablation)
+    overrides = {}
+    if args.batch_size:
+        overrides["batch_size"] = args.batch_size
+    if args.epochs:
+        overrides["epochs"] = args.epochs
+    if args.dtype:
+        overrides["compute_dtype"] = args.dtype
+    if args.beam_log_space:
+        overrides["beam_compat_prob_space"] = False
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+def _make_mesh(spec: Optional[str]):
+    from fira_tpu.parallel import mesh as pmesh
+
+    if spec is None:
+        import jax
+
+        n = len(jax.devices())
+        return pmesh.make_mesh(n_data=n) if n > 1 else None
+    dp, tp = (int(x) for x in spec.lower().split("x"))
+    return pmesh.make_mesh(n_data=dp, n_model=tp)
+
+
+def _load_var_maps(data_dir: str) -> Optional[List[dict]]:
+    path = os.path.join(data_dir, "variable.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.synthetic:
+        from fira_tpu.data.synthetic import write_corpus_dir
+
+        os.makedirs(args.data_dir, exist_ok=True)
+        write_corpus_dir(args.data_dir, n_commits=args.synthetic)
+        print(f"synthetic corpus: {args.synthetic} commits -> {args.data_dir}")
+
+    if args.command == "preprocess":
+        try:
+            from fira_tpu.preprocess.pipeline import main as preprocess_main
+        except ImportError:
+            print("the preprocessing pipeline is not available in this build",
+                  file=sys.stderr)
+            return 1
+        return preprocess_main(args)
+
+    cfg = _resolve_cfg(args)
+    from fira_tpu.data.dataset import FiraDataset
+
+    dataset = FiraDataset(args.data_dir, cfg)
+    cfg = dataset.cfg
+    var_maps = _load_var_maps(args.data_dir)
+    suffix = f"_{args.ablation}" if args.ablation else ""
+    ckpt_dir = args.ckpt_dir or os.path.join(args.out_dir, f"ckpt{suffix}")
+
+    if args.command == "train":
+        from fira_tpu.train.loop import train
+
+        mesh = _make_mesh(args.mesh)
+        result = train(
+            dataset, cfg, mesh=mesh, out_dir=args.out_dir,
+            ckpt_dir=ckpt_dir, epochs=args.epochs, var_maps=var_maps,
+            resume=not args.no_resume,
+        )
+        print(f"best dev bleu: {result.best_bleu:.4f}  "
+              f"throughput: {result.commits_per_sec_per_chip:.1f} "
+              f"commits/sec/chip")
+        return 0
+
+    # test: load best params, beam-decode, write OUTPUT file
+    import jax
+
+    from fira_tpu.decode.runner import output_name, run_test
+    from fira_tpu.model.model import FiraModel
+    from fira_tpu.train.state import CheckpointManager, init_state
+    from fira_tpu.data.batching import make_batch
+    import numpy as np
+
+    ckpt = CheckpointManager(ckpt_dir)
+    if not ckpt.has(CheckpointManager.BEST):
+        print(f"no best checkpoint under {ckpt_dir}; train first", file=sys.stderr)
+        return 1
+    model = FiraModel(cfg)
+    split = dataset.splits["test"]
+    sample = make_batch(split, np.arange(min(cfg.test_batch_size, len(split))),
+                        cfg, batch_size=cfg.test_batch_size)
+    template = init_state(model, cfg, sample)
+    params = ckpt.restore_best(template.params)
+    metrics = run_test(model, params, dataset, cfg, out_dir=args.out_dir,
+                       ablation=args.ablation, var_maps=var_maps)
+    print(f"test sentence-bleu: {metrics['sentence_bleu']:.4f} "
+          f"({int(metrics['n'])} commits) -> "
+          f"{os.path.join(args.out_dir, output_name(args.ablation))}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

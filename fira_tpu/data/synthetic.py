@@ -184,3 +184,37 @@ def write_corpus_dir(data_dir: str, n_commits: int, seed: int = 0,
     word_vocab.to_json(os.path.join(data_dir, "word_vocab.json"))
     ast_vocab.to_json(os.path.join(data_dir, "ast_change_vocab.json"))
     return corpus
+
+
+def make_memory_split(cfg, n: int, seed: int = 0, pad_vocab_to: int = 0,
+                      pad_ast_vocab_to: int = 0):
+    """Generate a fully in-memory ProcessedSplit (no disk): returns
+    (cfg with vocab sizes filled in, split, word_vocab).
+
+    ``pad_vocab_to`` / ``pad_ast_vocab_to`` inflate the vocab sizes so
+    benchmark runs match the reference's 24,650-word / 71-label vocab compute
+    without its corpus."""
+    from fira_tpu.data.dataset import ProcessedSplit, process_record
+
+    corpus = generate_corpus(n, seed=seed)
+    word_vocab, ast_vocab = build_vocabs(corpus)
+    cfg = cfg.replace(
+        vocab_size=max(len(word_vocab), pad_vocab_to),
+        ast_change_vocab_size=max(len(ast_vocab), pad_ast_vocab_to),
+    )
+    examples = [
+        process_record(corpus.record(i), word_vocab, ast_vocab, cfg)
+        for i in range(n)
+    ]
+    return cfg, ProcessedSplit.from_examples(examples), word_vocab
+
+
+def make_memory_batch(cfg, n: int, seed: int = 0, pad_vocab_to: int = 0):
+    """One in-memory batch of n fresh synthetic commits (no disk)."""
+    from fira_tpu.data.batching import make_batch
+
+    import numpy as np
+
+    cfg, split, word_vocab = make_memory_split(cfg, n, seed=seed,
+                                               pad_vocab_to=pad_vocab_to)
+    return cfg, make_batch(split, np.arange(n), cfg), word_vocab

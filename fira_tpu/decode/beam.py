@@ -1,0 +1,138 @@
+"""Jitted batched beam search.
+
+The reference's decoder loop (/root/reference/run_model.py:187-380) is pure
+Python: per step x per beam it re-runs the full decoder on the padded
+prefix, fuses gen+copy probabilities, multiplies by the running beam
+probability (PROBABILITIES, not log-probs, :271), appends finished-beam
+sentinel probabilities (:281-298), takes one global top-k (:305-310), and
+resolves copy ids to source token ids at beam-extension time (:334-337).
+
+This rebuild runs the whole thing as ONE compiled program: beams fold into
+the batch dim, `lax.scan` drives the tar_len-1 steps, and top-k replaces the
+sort. Two accumulation modes:
+
+- compat (default, cfg.beam_compat_prob_space=True): probability-space
+  accumulation with the reference's exact candidate construction —
+  finished beams contribute a -1-masked distribution PLUS a sentinel entry
+  carrying their probability, so selection order is bit-for-bit the
+  reference's (needed for +-0.3 BLEU parity, SURVEY.md hard-part 2);
+- log-space: the numerically sound default for long targets; identical
+  argmax behavior until probabilities underflow.
+
+Semantic note vs the reference: the reference skips a beam only when it is
+finished for EVERY batch item (cal_beam, :229-247) and compacts the sentinel
+list (:286-296); per item that yields exactly the candidate set built here
+(active beams: dist x prob; finished beams: -1-mask + sentinel), so the
+fixed-shape formulation selects the same beams without data-dependent
+control flow. Early loop exit (:276-279) is replaced by running all steps —
+finished beams are fixed points of the update.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fira_tpu.config import FiraConfig
+from fira_tpu.data.vocab import EOS_ID, START_ID
+from fira_tpu.model.model import FiraModel
+
+
+def _resolve_copy(tok, diff, sub_token, cfg: FiraConfig):
+    """Copy-id -> source token id (run_model.py:334-337), vectorized.
+
+    tok: (B, K) candidate ids over the fused output space;
+    diff: (B, sou_len); sub_token: (B, sub_token_len).
+    """
+    V = cfg.vocab_size
+    sub_pos = jnp.clip(tok - V - cfg.sou_len, 0, cfg.sub_token_len - 1)
+    diff_pos = jnp.clip(tok - V, 0, cfg.sou_len - 1)
+    from_sub = jnp.take_along_axis(sub_token, sub_pos, axis=1)
+    from_diff = jnp.take_along_axis(diff, diff_pos, axis=1)
+    return jnp.where(
+        tok >= V + cfg.sou_len, from_sub,
+        jnp.where(tok >= V, from_diff, tok),
+    )
+
+
+def beam_search(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
+                cfg: FiraConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (tokens (B, beam, tar_len) with copy ids already resolved,
+    scores (B, beam)). The best beam is argmax(scores) (run_model.py:351).
+
+    Jit this via `make_beam_step` below or wrap in jax.jit at the call site;
+    everything inside is fixed-shape.
+    """
+    K, T, V_out = cfg.beam_size, cfg.tar_len, cfg.output_vocab_size
+    B = batch["diff"].shape[0]
+    prob_space = cfg.beam_compat_prob_space
+
+    states, mask = model.apply({"params": params}, batch,
+                               method=FiraModel.encode)
+    # fold beams into batch for the decoder: (B*K, ...)
+    states_k = jnp.repeat(states, K, axis=0)
+    mask_k = jnp.repeat(mask, K, axis=0)
+
+    tokens0 = jnp.zeros((B, K, T), jnp.int32).at[:, :, 0].set(START_ID)
+    if prob_space:
+        # beam 0 prob 1, others 0 (run_model.py:216-221)
+        probs0 = jnp.tile(jnp.asarray([1.0] + [0.0] * (K - 1), jnp.float32),
+                          (B, 1))
+        neg = jnp.float32(-1.0)  # reference's masked/-pad value (:273,294)
+    else:
+        probs0 = jnp.tile(
+            jnp.asarray([0.0] + [-np.inf] * (K - 1), jnp.float32), (B, 1)
+        )
+        neg = jnp.float32(-np.inf)
+    finished0 = jnp.zeros((B, K), bool)
+
+    def step(carry, s):
+        tokens, probs, finished = carry
+        flat = tokens.reshape(B * K, T)
+        # active prefixes all have length s+1; pad mask = positions <= s for
+        # active beams, < own length for finished (their tail is 0-padded, and
+        # they are masked out of selection anyway)
+        tar_mask = flat != 0
+        tar_mask = tar_mask.at[:, 0].set(True)  # <start> may be id 0? no: 2
+        fused = model.apply(
+            {"params": params}, states_k, mask_k, flat, tar_mask,
+            method=FiraModel.fused_probs,
+        )  # (B*K, T, V_out)
+        dist = fused[:, s, :].reshape(B, K, V_out)
+        if prob_space:
+            cand = dist * probs[:, :, None]
+        else:
+            cand = jnp.log(jnp.clip(dist, 1e-10, 1.0)) + probs[:, :, None]
+        cand = jnp.where(finished[:, :, None], neg, cand)
+        sentinel = jnp.where(finished, probs, neg)          # (B, K)
+        allc = jnp.concatenate([cand.reshape(B, K * V_out), sentinel], axis=1)
+        top_vals, top_idx = jax.lax.top_k(allc, K)          # (B, K)
+
+        is_sent = top_idx >= K * V_out
+        src_beam = jnp.where(is_sent, top_idx - K * V_out, top_idx // V_out)
+        tok = jnp.where(is_sent, 0, top_idx % V_out)
+        tok = _resolve_copy(tok, batch["diff"], batch["sub_token"], cfg)
+
+        gather = lambda arr: jnp.take_along_axis(
+            arr, src_beam.reshape(B, K, *([1] * (arr.ndim - 2))), axis=1
+        )
+        new_tokens = gather(tokens)
+        keep = new_tokens[:, :, s + 1]  # finished beams keep their padding
+        new_tokens = new_tokens.at[:, :, s + 1].set(
+            jnp.where(is_sent, keep, tok)
+        )
+        new_finished = jnp.where(is_sent, True, tok == EOS_ID)
+        return (new_tokens, top_vals, new_finished), None
+
+    (tokens, probs, _), _ = jax.lax.scan(
+        step, (tokens0, probs0, finished0), jnp.arange(T - 1)
+    )
+    return tokens, probs
+
+
+def make_beam_search(model: FiraModel, cfg: FiraConfig):
+    """jit-compiled beam search closure over (params, batch)."""
+    return jax.jit(lambda params, batch: beam_search(model, params, batch, cfg))

@@ -1,0 +1,73 @@
+"""Test-split decoding driver (the reference's `test()`,
+/root/reference/run_model.py:187-380): beam-search every batch, pick the
+argmax-probability beam, cook text, score in-loop sentence BLEU, and write
+one prediction per line to OUTPUT/output_fira (ablations write their own
+suffixed files, matching OUTPUT/output_fira_{no_edit,no_subtoken,nothing}).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from fira_tpu.config import FiraConfig
+from fira_tpu.data.batching import epoch_batches
+from fira_tpu.data.dataset import FiraDataset
+from fira_tpu.decode.beam import make_beam_search
+from fira_tpu.decode.text import cook_prediction, deanonymize, reference_words
+from fira_tpu.eval.dev_bleu import nltk_sentence_bleu
+from fira_tpu.model.model import FiraModel
+
+
+def output_name(ablation: Optional[str]) -> str:
+    """OUTPUT file naming per paper ablation (BASELINE.md rows)."""
+    if ablation in (None, "", "none", "full"):
+        return "output_fira"
+    return f"output_fira_{ablation}"
+
+
+def run_test(model: FiraModel, params, dataset: FiraDataset,
+             cfg: Optional[FiraConfig] = None, *,
+             out_dir: str = "OUTPUT",
+             ablation: Optional[str] = None,
+             var_maps: Optional[List[Dict[str, str]]] = None,
+             split: str = "test") -> Dict[str, float]:
+    cfg = cfg or dataset.cfg
+    data = dataset.splits[split]
+    vocab = dataset.word_vocab
+    indices = dataset.split_indices[split]
+    beam = make_beam_search(model, cfg)
+
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, output_name(ablation))
+    lines: List[str] = []
+    total_bleu, n = 0.0, 0
+    cursor = 0
+    for batch in epoch_batches(data, cfg, batch_size=cfg.test_batch_size):
+        tokens, probs = beam(params, batch)
+        tokens = np.asarray(jax.device_get(tokens))
+        probs = np.asarray(jax.device_get(probs))
+        valid = np.asarray(batch["valid"])
+        for i in range(tokens.shape[0]):
+            if not valid[i]:
+                continue
+            best = int(np.argmax(probs[i]))          # run_model.py:351
+            ids = tokens[i, best].tolist()
+            # beam output ids are already copy-resolved at extension time
+            hyp = cook_prediction(ids[1:], batch["diff"][i],
+                                  batch["sub_token"][i], vocab, cfg,
+                                  resolve=False)
+            ref = reference_words(batch["msg"][i], vocab)
+            total_bleu += nltk_sentence_bleu([ref], hyp)
+            n += 1
+            var_map = (var_maps[indices[cursor]]
+                       if var_maps is not None else None)
+            lines.append(" ".join(deanonymize(hyp, var_map)))
+            cursor += 1
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return {"sentence_bleu": total_bleu / max(n, 1), "n": float(n),
+            "output_path": out_path}  # type: ignore[return-value]

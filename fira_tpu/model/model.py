@@ -202,10 +202,12 @@ class FiraModel(nn.Module):
         mask = jnp.concatenate([sou_mask, sub_mask], axis=1)
         return states, mask
 
-    def fused_log_probs(self, states, mask, tar, tar_mask_pad, *,
-                        deterministic: bool = True):
-        """Decoder + copy fusion -> log distribution over
-        vocab_size + sou_len + sub_token_len (Model.py:52-69)."""
+    def fused_probs(self, states, mask, tar, tar_mask_pad, *,
+                    deterministic: bool = True):
+        """Decoder + copy fusion -> probability-space distribution over
+        vocab_size + sou_len + sub_token_len (Model.py:52-64). The beam
+        search consumes this directly in its reference-compat prob-space
+        accumulation mode (run_model.py:257-271)."""
         tar_emb = self.decoder(tar, states, mask, tar_mask_pad,
                                deterministic=deterministic)
         gen = jax.nn.softmax(
@@ -214,9 +216,15 @@ class FiraModel(nn.Module):
         scores, gate = self.copy_net(states, tar_emb)
         scores = jnp.where(mask[:, None, :], scores, jnp.asarray(-1e9, scores.dtype))
         copy = jax.nn.softmax(scores.astype(stable_dtype(self.dtype)), axis=-1)
-        fused = jnp.concatenate(
+        return jnp.concatenate(
             [gate[:, :, 0:1] * gen, gate[:, :, 1:2] * copy], axis=-1
         )
+
+    def fused_log_probs(self, states, mask, tar, tar_mask_pad, *,
+                        deterministic: bool = True):
+        """log-clamped fused distribution (Model.py:69: clip to [1e-10, 1])."""
+        fused = self.fused_probs(states, mask, tar, tar_mask_pad,
+                                 deterministic=deterministic)
         return jnp.log(jnp.clip(fused, 1e-10, 1.0))
 
     def __call__(self, batch: Dict[str, jnp.ndarray], *,

@@ -1,0 +1,99 @@
+"""Train state + checkpointing.
+
+The reference checkpoints only the best-on-dev ``model.state_dict()``
+(/root/reference/run_model.py:94-96) — no optimizer state, no resume. Here
+the full train state (step, params, Adam moments, dev-gating bookkeeping,
+PRNG key) round-trips through orbax, so a preempted TPU run resumes exactly;
+the best-on-dev params are additionally kept as their own checkpoint, like
+the reference's ``best_model.pt``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from fira_tpu.config import FiraConfig
+from fira_tpu.model.model import FiraModel
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+
+def make_optimizer(cfg: FiraConfig) -> optax.GradientTransformation:
+    """Adam(lr=1e-4) with torch defaults (run_model.py:396): betas (0.9,
+    0.999), eps 1e-8 — identical to optax defaults."""
+    return optax.adam(cfg.lr)
+
+
+def init_state(model: FiraModel, cfg: FiraConfig, sample_batch: Dict[str, Any],
+               seed: Optional[int] = None) -> TrainState:
+    rng = jax.random.PRNGKey(cfg.seed if seed is None else seed)
+    init_rng, state_rng = jax.random.split(rng)
+    params = model.init(init_rng, sample_batch, deterministic=True)["params"]
+    opt_state = make_optimizer(cfg).init(params)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=opt_state, rng=state_rng,
+    )
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+class CheckpointManager:
+    """Orbax-backed save/restore of (state, best_params, metadata)."""
+
+    LATEST = "latest"
+    BEST = "best"
+
+    def __init__(self, ckpt_dir: str):
+        import orbax.checkpoint as ocp
+
+        self.ckpt_dir = os.path.abspath(ckpt_dir)
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        self._ckpt = ocp.PyTreeCheckpointer()
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.ckpt_dir, name)
+
+    def save_latest(self, state: TrainState, *, best_bleu: float,
+                    epoch: int) -> None:
+        payload = {
+            "state": jax.device_get(state),
+            "meta": {"best_bleu": float(best_bleu), "epoch": int(epoch)},
+        }
+        self._ckpt.save(self._path(self.LATEST), payload, force=True)
+
+    def save_best(self, params) -> None:
+        """The reference's best_model.pt equivalent (run_model.py:96):
+        params only, gated on dev BLEU by the caller."""
+        self._ckpt.save(self._path(self.BEST), jax.device_get(params),
+                        force=True)
+
+    def has(self, name: str) -> bool:
+        return os.path.isdir(self._path(name))
+
+    def restore_latest(self, template_state: TrainState
+                       ) -> Tuple[TrainState, Dict[str, Any]]:
+        payload = self._ckpt.restore(
+            self._path(self.LATEST),
+            item={"state": jax.device_get(template_state),
+                  "meta": {"best_bleu": 0.0, "epoch": 0}},
+        )
+        return payload["state"], payload["meta"]
+
+    def restore_best(self, template_params):
+        return self._ckpt.restore(self._path(self.BEST),
+                                  item=jax.device_get(template_params))

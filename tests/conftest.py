@@ -31,9 +31,19 @@ def _force_cpu_backend():
     try:
         from jax._src import xla_bridge as xb
 
+        def _disabled(*_a, **_k):
+            raise RuntimeError("non-cpu backend disabled by tests/conftest.py")
+
         for name in list(getattr(xb, "_backend_factories", {})):
             if name != "cpu":
-                del xb._backend_factories[name]
+                # Keep the name registered (mlir.register_lowering validates
+                # platform names against this table — chex/checkify registers
+                # tpu lowerings at import) but make the factory inert so
+                # nothing ever dials the tunnel.
+                import dataclasses as _dc
+
+                entry = xb._backend_factories[name]
+                xb._backend_factories[name] = _dc.replace(entry, factory=_disabled)
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,89 @@
+"""Hunk-FSM unit tests encoding the reference's invariants
+(/root/reference/Preprocess/run_total_process_data.py:8-158): segment typing,
+update pairing, <nb>-block handling, end-of-stream flush, and the global
+token-reconstruction invariant (process_data_ast_parallel.py:420)."""
+
+import pytest
+
+from fira_tpu.preprocess.fsm import FSMError, flatten_chunks, split_hunks
+
+
+def seg(tokens_marks):
+    tokens = [t for t, _ in tokens_marks]
+    marks = [m for _, m in tokens_marks]
+    return split_hunks(tokens, marks)
+
+
+def test_header_block_is_context_chunk():
+    chunks, types = seg([("<nb>", 2), ("class", 2), ("A", 2), ("<nl>", 2),
+                         ("x", 2), (";", 2)])
+    assert types == [0, 0]
+    assert chunks[0] == ["<nb>", "class", "A", "<nl>"]
+    assert chunks[1] == ["x", ";"]
+
+
+def test_pure_delete_and_add_runs():
+    chunks, types = seg([("a", 2), ("b", 1), ("c", 1), ("d", 2), ("e", 3)])
+    assert types == [0, -1, 0, 1]
+    assert chunks == [["a"], ["b", "c"], ["d"], ["e"]]
+
+
+def test_update_pairs_delete_then_add():
+    chunks, types = seg([("x", 1), ("y", 3), ("z", 2)])
+    assert types == [100, 0]
+    assert chunks[0] == (["x"], ["y"])
+
+
+def test_delete_flushed_by_context_is_not_update():
+    # delete, context, add => -1 then 0 then 1 (NOT an update):
+    # run_total_process_data.py:94-99 flushes the delete-run on mark 2
+    chunks, types = seg([("x", 1), ("c", 2), ("y", 3)])
+    assert types == [-1, 0, 1]
+
+
+def test_interleaved_update_runs():
+    # d a d a: each delete->add pair becomes its own update chunk
+    chunks, types = seg([("d1", 1), ("a1", 3), ("d2", 1), ("a2", 3)])
+    assert types == [100, 100]
+    assert chunks == [(["d1"], ["a1"]), (["d2"], ["a2"])]
+
+
+def test_update_flush_at_nb_and_eos():
+    chunks, types = seg([("d", 1), ("a", 3), ("<nb>", 2), ("h", 2), ("<nl>", 2)])
+    assert types == [100, 0]
+    chunks, types = seg([("d", 1), ("a", 3)])
+    assert types == [100]
+
+
+def test_add_flushed_by_delete_without_pending_delete():
+    # add-run then delete (no pending delete): add flushes as type 1
+    chunks, types = seg([("a", 3), ("d", 1)])
+    assert types == [1, -1]
+
+
+def test_nb_block_must_be_context():
+    with pytest.raises(FSMError):
+        seg([("<nb>", 2), ("class", 1), ("<nl>", 2)])
+    with pytest.raises(FSMError):
+        seg([("<nb>", 3), ("<nl>", 2)])
+    with pytest.raises(FSMError):
+        seg([("<nb>", 2), ("class", 2)])  # unclosed block
+
+
+def test_flatten_reconstructs_stream():
+    tokens = ["<nb>", "f", "<nl>", "k", "d1", "d2", "a1", "c", "x", "y"]
+    marks = [2, 2, 2, 2, 1, 1, 3, 2, 3, 3]
+    chunks, types = split_hunks(tokens, marks)
+    assert flatten_chunks(chunks, types) == tokens
+
+
+def test_length_mismatch_raises():
+    with pytest.raises(FSMError):
+        split_hunks(["a"], [1, 2])
+
+
+def test_out_of_domain_mark_raises():
+    with pytest.raises(FSMError):
+        split_hunks(["x", "y"], [0, 2])
+    with pytest.raises(FSMError):
+        split_hunks(["x", "y"], [2, 4])
