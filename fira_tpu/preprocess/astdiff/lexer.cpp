@@ -28,10 +28,10 @@ const std::unordered_set<std::string>& keywords() {
 }
 
 // Multi-char operators, longest first within each leading char.
-const std::array<const char*, 26> MULTI_OPS = {
+const std::array<const char*, 25> MULTI_OPS = {
     ">>>=", ">>>", ">>=", ">>", ">=", "<<=", "<<", "<=", "...", "->",
     "::",   "==",  "!=",  "&&", "&=", "||",  "|=", "++", "+=",  "--",
-    "-=",   "*=",  "/=",  "%=", "^=", "=="};
+    "-=",   "*=",  "/=",  "%=", "^="};
 
 bool ident_start(char c) {
   return std::isalpha(static_cast<unsigned char>(c)) || c == '_' || c == '$' ||

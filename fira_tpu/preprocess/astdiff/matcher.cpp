@@ -20,6 +20,7 @@
 #include <map>
 #include <sstream>
 #include <unordered_map>
+#include <unordered_set>
 
 namespace astdiff {
 
@@ -113,8 +114,7 @@ void align_children(const Node* o, const Node* n, Mapping& m) {
   }
 }
 
-void last_chance(const Node* o, const Node* n, const Tree& told,
-                 const Tree& tnew, Mapping& m) {
+void last_chance(const Node* o, const Node* n, Mapping& m) {
   align_children(o, n, m);
   std::vector<const Node*> od, nd;
   collect_descendants(o, od);
@@ -140,7 +140,6 @@ void last_chance(const Node* o, const Node* n, const Tree& told,
       }
     }
   }
-  (void)told; (void)tnew;
 }
 
 }  // namespace
@@ -210,20 +209,20 @@ Mapping match_trees(const Tree& told, const Tree& tnew) {
     // same typeLabel
     std::vector<const Node*> od;
     collect_descendants(o, od);
-    std::unordered_map<int, int> votes;
+    std::unordered_set<int> candidates;
     for (const Node* d : od) {
       int t = m.o2n[d->id];
       if (t == -1) continue;
       const Node* a = tnew.preorder[t]->parent;
       while (a) {
         if (a->typeLabel == o->typeLabel && m.n2o[a->id] == -1)
-          votes[a->id]++;
+          candidates.insert(a->id);
         a = a->parent;
       }
     }
     const Node* best = nullptr;
     double best_dice = -1.0;
-    for (auto& [nid, cnt] : votes) {
+    for (int nid : candidates) {
       const Node* c = tnew.preorder[nid];
       double d = dice(od, c, m);
       if (d > best_dice) { best_dice = d; best = c; }
@@ -231,7 +230,7 @@ Mapping match_trees(const Tree& told, const Tree& tnew) {
     if (best && (best_dice > kDiceThreshold || is_root)) {
       m.o2n[o->id] = best->id;
       m.n2o[best->id] = o->id;
-      last_chance(o, best, told, tnew, m);
+      last_chance(o, best, m);
     }
   }
   // roots always correspond (both CompilationUnit)
@@ -239,7 +238,7 @@ Mapping match_trees(const Tree& told, const Tree& tnew) {
       told.root->typeLabel == tnew.root->typeLabel) {
     m.o2n[told.root->id] = tnew.root->id;
     m.n2o[tnew.root->id] = told.root->id;
-    last_chance(told.root, tnew.root, told, tnew, m);
+    last_chance(told.root, tnew.root, m);
   }
   return m;
 }

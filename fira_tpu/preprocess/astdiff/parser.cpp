@@ -85,6 +85,21 @@ class Parser {
   // undo log for '>' splitting so speculative parses can rewind cleanly
   std::vector<std::pair<size_t, Token>> undo_;
 
+  // Recursion bound: the library runs in-process (ctypes), so pathological
+  // nesting must become ParseError, not a C-stack overflow taking the whole
+  // Python worker down. 300 levels also keeps the emitted JSON within
+  // Python's default json.loads recursion budget.
+  static constexpr int kMaxDepth = 300;
+  int depth_ = 0;
+  struct DepthGuard {
+    Parser& p;
+    explicit DepthGuard(Parser& pp) : p(pp) {
+      if (p.depth_ >= kMaxDepth) p.err("nesting too deep");
+      ++p.depth_;
+    }
+    ~DepthGuard() { --p.depth_; }
+  };
+
   struct State { size_t p, undo; };
   State save() { return {p_, undo_.size()}; }
   void restore(const State& st) {
@@ -164,6 +179,7 @@ class Parser {
     return at_op("@") && peek().kind == Tok::Ident;
   }
   Node* parse_annotation() {
+    DepthGuard dg(*this);
     size_t s = mark();
     expect_op("@");
     Node* name = parse_name_leaf();
@@ -246,6 +262,7 @@ class Parser {
   }
 
   Node* parse_type() {
+    DepthGuard dg(*this);
     size_t s = mark();
     Node* base;
     if (cur().kind == Tok::Keyword && is_primitive(cur().text)) {
@@ -349,7 +366,9 @@ class Parser {
   void parse_type_args(std::vector<Node*>& out) {
     expect_op("<");
     if (at_op(">")) { advance(); return; }  // diamond
-    if (cur().kind == Tok::Op && cur().text == ">>") { expect_gt(); expect_gt(); return; }
+    // Diamond whose '>' was lexed into the enclosing list's closer ('<>>'):
+    // split the '>>', consuming one '>' and leaving one for the outer list.
+    if (cur().kind == Tok::Op && cur().text == ">>") { expect_gt(); return; }
     while (true) {
       if (at_op("?")) {
         size_t ws = mark();
@@ -408,6 +427,7 @@ class Parser {
   }
 
   Node* parse_class_or_interface(std::vector<Node*>& mods, size_t s) {
+    DepthGuard dg(*this);
     advance();  // class|interface
     Node* n = node("TypeDeclaration");
     n->children = mods;
@@ -482,7 +502,7 @@ class Parser {
       n->children = mods;
       for (Node* tp : tparams) n->children.push_back(tp);
       n->children.push_back(simple_name());
-      parse_method_rest(n, /*ctor=*/true);
+      parse_method_rest(n);
       finish(n, s);
       return n;
     }
@@ -494,7 +514,7 @@ class Parser {
       for (Node* tp : tparams) n->children.push_back(tp);
       n->children.push_back(type);
       n->children.push_back(leaf("SimpleName", name));
-      parse_method_rest(n, /*ctor=*/false);
+      parse_method_rest(n);
       // annotation-type member: `type name() default v;`
       finish(n, s);
       return n;
@@ -509,8 +529,7 @@ class Parser {
     return n;
   }
 
-  void parse_method_rest(Node* n, bool ctor) {
-    (void)ctor;
+  void parse_method_rest(Node* n) {
     expect_op("(");
     if (!at_op(")")) {
       while (true) {
@@ -586,6 +605,7 @@ class Parser {
   }
 
   Node* parse_enum(std::vector<Node*>& mods, size_t s) {
+    DepthGuard dg(*this);
     expect_kw("enum");
     Node* n = node("EnumDeclaration");
     n->children = mods;
@@ -701,6 +721,7 @@ class Parser {
   }
 
   Node* parse_statement() {
+    DepthGuard dg(*this);
     size_t s = mark();
     if (at_op("{")) return parse_block();
     if (at_op(";")) { advance(); Node* n = node("EmptyStatement"); finish(n, s); return n; }
@@ -1126,6 +1147,7 @@ class Parser {
   }
 
   Node* parse_unary() {
+    DepthGuard dg(*this);
     size_t s = mark();
     if (cur().kind == Tok::Op &&
         (cur().text == "+" || cur().text == "-" || cur().text == "!" ||
@@ -1256,12 +1278,22 @@ class Parser {
           continue;
         }
         if (peek().kind == Tok::Keyword && peek().text == "super") {
-          // Outer.super.m(...) — rare; treat like super method invocation
+          // Outer.super.m(...) / Outer.super.x — keep the qualifier as the
+          // first child (JDT shape) so its source token stays in the tree.
           advance(); advance();
           expect_op(".");
-          Node* n = node("SuperMethodInvocation");
-          n->children.push_back(simple_name());
-          if (at_op("(")) parse_args(n->children);
+          Node* name = simple_name();
+          Node* n;
+          if (at_op("(")) {
+            n = node("SuperMethodInvocation");
+            n->children.push_back(e);
+            n->children.push_back(name);
+            parse_args(n->children);
+          } else {
+            n = node("SuperFieldAccess");
+            n->children.push_back(e);
+            n->children.push_back(name);
+          }
           finish(n, s);
           e = n;
           continue;
@@ -1322,6 +1354,7 @@ class Parser {
   }
 
   Node* parse_array_initializer() {
+    DepthGuard dg(*this);
     size_t s = mark();
     expect_op("{");
     Node* n = node("ArrayInitializer");
@@ -1472,10 +1505,8 @@ class Parser {
       Token tk = advance();
       if (at_op("(")) {  // this(...) constructor invocation (expression pos)
         Node* n = node("ConstructorInvocation");
-        n->pos = tk.pos;
         parse_args(n->children);
-        const Token& last = toks_[p_ - 1];
-        n->length = last.pos + static_cast<int>(last.text.size()) - n->pos;
+        finish(n, s);
         return n;
       }
       return leaf("ThisExpression", tk, /*with_label=*/false);
@@ -1484,28 +1515,22 @@ class Parser {
       Token tk = advance();
       if (at_op("(")) {
         Node* n = node("SuperConstructorInvocation");
-        n->pos = tk.pos;
         parse_args(n->children);
-        const Token& last = toks_[p_ - 1];
-        n->length = last.pos + static_cast<int>(last.text.size()) - n->pos;
+        finish(n, s);
         return n;
       }
       expect_op(".");
       Token name = expect_ident();
       if (at_op("(")) {
         Node* n = node("SuperMethodInvocation");
-        n->pos = tk.pos;
         n->children.push_back(leaf("SimpleName", name));
         parse_args(n->children);
-        const Token& last = toks_[p_ - 1];
-        n->length = last.pos + static_cast<int>(last.text.size()) - n->pos;
+        finish(n, s);
         return n;
       }
       Node* n = node("SuperFieldAccess");
-      n->pos = tk.pos;
       n->children.push_back(leaf("SimpleName", name));
-      const Token& last = toks_[p_ - 1];
-      n->length = last.pos + static_cast<int>(last.text.size()) - n->pos;
+      finish(n, s);
       return n;
     }
     if (at_kw("new")) return parse_new(s, nullptr);
@@ -1532,11 +1557,9 @@ class Parser {
       Token name = advance();
       if (at_op("(")) {
         Node* n = node("MethodInvocation");
-        n->pos = name.pos;
         n->children.push_back(leaf("SimpleName", name));
         parse_args(n->children);
-        const Token& last = toks_[p_ - 1];
-        n->length = last.pos + static_cast<int>(last.text.size()) - n->pos;
+        finish(n, s);
         return n;
       }
       return leaf("SimpleName", name);

@@ -1,0 +1,146 @@
+"""ctypes binding for the native astdiff component.
+
+The reference shells out to a vendored Java GumTree per chunk — two JVM
+subprocess launches per update hunk (/root/reference/Preprocess/
+get_ast_root_action.py:70,124). Here the C++ library is loaded once per
+process and called in-process: no JVM, no fork/exec, no temp .java files.
+
+Python surface (all return None on unparseable input, mirroring the
+reference's graceful degradation at process_data_ast_parallel.py:204-217):
+
+    tokenize(src)   -> [token_text]            (javalang.tokenizer stand-in)
+    parse_json(src) -> {"root": {...}}         (`parse` CLI contract payload)
+    diff_lines(a,b) -> ["Match ...", ...]      (`diff` CLI contract lines)
+
+The CLI binary (``astdiff parse|diff``) built by the same Makefile is the
+subprocess-compatible contract surface kept for differential testing against
+the reference's GumTree jar.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import json
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+ASTDIFF_DIR = os.path.join(_HERE, "astdiff")
+LIB_PATH = os.path.join(ASTDIFF_DIR, "libastdiff.so")
+CLI_PATH = os.path.join(ASTDIFF_DIR, "astdiff")
+
+_SOURCES = ("astdiff.hpp", "lexer.cpp", "parser.cpp", "matcher.cpp",
+            "capi.cpp", "Makefile")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class AstdiffBuildError(RuntimeError):
+    pass
+
+
+def _stale() -> bool:
+    if not os.path.exists(LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(ASTDIFF_DIR, s)) > lib_mtime
+        for s in _SOURCES if os.path.exists(os.path.join(ASTDIFF_DIR, s))
+    )
+
+
+def build(force: bool = False) -> str:
+    """Build libastdiff.so (and the CLI) if missing or older than sources.
+
+    Safe under concurrent builders (a multiprocessing worker pool all hitting
+    first use): an exclusive file lock serializes the compiles, and each
+    compile writes to a private temp name then atomically renames into place,
+    so no process can ever dlopen a half-written library.
+    """
+    with _lock:
+        if not (force or _stale()):
+            return LIB_PATH
+        lock_path = os.path.join(ASTDIFF_DIR, ".build.lock")
+        with open(lock_path, "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                if not (force or _stale()):  # a peer built it while we waited
+                    return LIB_PATH
+                tmp_lib = f"libastdiff.so.{os.getpid()}.tmp"
+                tmp_bin = f"astdiff.{os.getpid()}.tmp"
+                proc = subprocess.run(
+                    ["make", "-C", ASTDIFF_DIR, f"LIB={tmp_lib}",
+                     f"BIN={tmp_bin}"],
+                    capture_output=True, text=True)
+                if proc.returncode != 0:
+                    raise AstdiffBuildError(
+                        f"astdiff build failed:\n{proc.stdout}\n{proc.stderr}")
+                os.replace(os.path.join(ASTDIFF_DIR, tmp_lib), LIB_PATH)
+                os.replace(os.path.join(ASTDIFF_DIR, tmp_bin), CLI_PATH)
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
+    return LIB_PATH
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    build()
+    with _lock:
+        if _lib is None:
+            lib = ctypes.CDLL(LIB_PATH)
+            for fn in ("astdiff_parse", "astdiff_tokenize"):
+                getattr(lib, fn).argtypes = [ctypes.c_char_p]
+                getattr(lib, fn).restype = ctypes.c_void_p
+            lib.astdiff_diff.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+            lib.astdiff_diff.restype = ctypes.c_void_p
+            lib.astdiff_free.argtypes = [ctypes.c_void_p]
+            lib.astdiff_free.restype = None
+            _lib = lib
+    return _lib
+
+
+def _take(lib: ctypes.CDLL, ptr: Optional[int]) -> Optional[str]:
+    """Copy a malloc'd C string into Python and free it."""
+    if not ptr:
+        return None
+    try:
+        return ctypes.string_at(ptr).decode("utf-8", errors="replace")
+    finally:
+        lib.astdiff_free(ptr)
+
+
+def tokenize(src: str) -> Optional[List[str]]:
+    lib = _load()
+    out = _take(lib, lib.astdiff_tokenize(src.encode("utf-8")))
+    if out is None:
+        return None
+    return [t for t in out.split("\n") if t]
+
+
+def parse_json(src: str) -> Optional[dict]:
+    lib = _load()
+    out = _take(lib, lib.astdiff_parse(src.encode("utf-8")))
+    if out is None:
+        return None
+    try:
+        return json.loads(out)
+    except RecursionError:
+        # The parser bounds tree depth well inside json.loads' budget, but if
+        # the caller runs under a lowered recursion limit, degrade like any
+        # other unparseable chunk instead of blowing up the worker.
+        return None
+
+
+def diff_lines(src_old: str, src_new: str) -> Optional[List[str]]:
+    lib = _load()
+    out = _take(lib, lib.astdiff_diff(src_old.encode("utf-8"),
+                                      src_new.encode("utf-8")))
+    if out is None:
+        return None
+    return [ln for ln in out.splitlines() if ln.strip()]

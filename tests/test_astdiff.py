@@ -1,0 +1,249 @@
+"""Contract tests for the native astdiff component (C++ GumTree equivalent).
+
+The two contracts under test are the ONLY interface the preprocessing
+pipeline depends on (reference get_ast_root_action.py:69-101 `parse`,
+:123-232 `diff` + its cross-check asserts, reproduced here as test
+invariants).
+"""
+
+import json
+import re
+import subprocess
+
+import pytest
+
+from fira_tpu.preprocess import astdiff_binding as ad
+
+OLD_SRC = """
+public class Foo {
+    private int count;
+    public int getCount() { return count; }
+    public void reset() { count = 0; }
+}
+"""
+
+NEW_SRC = """
+public class Foo {
+    private int count;
+    public int getCount() { return this.count; }
+    public void reset(int base) { count = base; }
+}
+"""
+
+# Update's new name may contain whitespace (string-literal labels); the
+# reference bridge parses it as everything after ' to '
+# (get_ast_root_action.py:147), so the grammar allows .+ there.
+ACTION_RE = re.compile(
+    r"^(Match .+ to .+|Update .+ to .+|Move .+ into .+ at \d+|"
+    r"Insert .+ into .+ at \d+|Delete .+)$")
+
+NODE_RE = re.compile(r"^(?P<typ>[A-Za-z]+)(?:: (?P<name>.+?))?\((?P<idx>\d+)\)$")
+
+
+def parse_actor(s):
+    m = NODE_RE.match(s.strip())
+    assert m, f"malformed action node {s!r}"
+    return m.group("typ"), m.group("name"), int(m.group("idx"))
+
+
+def iter_nodes(node):
+    yield node
+    for c in node["children"]:
+        yield from iter_nodes(c)
+
+
+@pytest.fixture(scope="module")
+def lib():
+    ad.build()
+    return ad
+
+
+class TestParseContract:
+    def test_json_shape(self, lib):
+        ast = lib.parse_json(OLD_SRC)
+        assert set(ast.keys()) == {"root"}
+        ids = []
+        for node in iter_nodes(ast["root"]):
+            for key in ("id", "type", "typeLabel", "pos", "length", "children"):
+                assert key in node, f"missing {key}"
+            ids.append(node["id"])
+        # preorder ids, dense from 0
+        assert ids == sorted(ids) and ids[0] == 0 and len(set(ids)) == len(ids)
+
+    def test_leaf_labels_are_source_tokens(self, lib):
+        ast = lib.parse_json(OLD_SRC)
+        leaves = [n for n in iter_nodes(ast["root"]) if not n["children"]]
+        labels = {n["label"] for n in leaves if "label" in n}
+        for tok in ("Foo", "count", "getCount", "reset", "0", "int"):
+            assert tok in labels
+
+    def test_null_this_literals_carry_no_label(self, lib):
+        # The reference asserts label is absent then injects it
+        # (get_ast_root_action.py:56-61).
+        src = "class A { Object f() { if (this == null) return null; return this; } }"
+        ast = lib.parse_json(src)
+        for n in iter_nodes(ast["root"]):
+            if n["typeLabel"] in ("NullLiteral", "ThisExpression"):
+                assert "label" not in n or n["label"] is None
+
+    def test_positions_point_into_source(self, lib):
+        ast = lib.parse_json(OLD_SRC)
+        for n in iter_nodes(ast["root"]):
+            if "label" in n and n["label"] and not n["children"]:
+                pos, ln = n["pos"], n["length"]
+                assert OLD_SRC[pos:pos + ln] == n["label"], n
+
+    def test_children_within_parent_span(self, lib):
+        ast = lib.parse_json(OLD_SRC)
+        for n in iter_nodes(ast["root"]):
+            for c in n["children"]:
+                assert c["pos"] >= n["pos"]
+                assert c["pos"] + c["length"] <= n["pos"] + n["length"]
+
+    def test_garbage_returns_none(self, lib):
+        assert lib.parse_json("%%% not java @@@ ((((") is None
+
+    def test_pathological_nesting_degrades_not_crashes(self, lib):
+        # The library is in-process: deep nesting must come back as None
+        # (ParseError in C++), never a stack-overflow killing the worker.
+        deep = "class A { int x = " + "(" * 20000 + "1" + ")" * 20000 + "; }"
+        assert lib.parse_json(deep) is None
+        deep_stmt = "class A { void f() " + "{" * 20000 + "}" * 20000 + " }"
+        assert lib.parse_json(deep_stmt) is None
+        deep_class = "class A { " + "class B { " * 20000 + "}" * 20000 + " }"
+        assert lib.parse_json(deep_class) is None
+        deep_arr = ("class A { int[] x = " + "{" * 20000 + "1"
+                    + "}" * 20000 + "; }")
+        assert lib.parse_json(deep_arr) is None
+        deep_ann = ("@X(" * 20000 + "1" + ")" * 20000 + " class A { }")
+        assert lib.parse_json(deep_ann) is None
+        deep_enum = "enum E { ; " * 20000 + "}" * 20000
+        assert lib.parse_json(deep_enum) is None
+        # bounded nesting still parses
+        ok = "class A { int x = " + "(" * 50 + "1" + ")" * 50 + "; }"
+        assert lib.parse_json(ok) is not None
+
+    def test_qualified_super_keeps_qualifier(self, lib):
+        src = ("class A extends B { int y; "
+               "int f() { return A.super.g() + A.super.y; } }")
+        ast = lib.parse_json(src)
+        assert ast is not None
+        nodes = list(iter_nodes(ast["root"]))
+        sups = [n["typeLabel"] for n in nodes
+                if n["typeLabel"].startswith("Super")]
+        assert "SuperMethodInvocation" in sups
+        assert "SuperFieldAccess" in sups
+        # the qualifier token 'A' inside the method body must survive as a leaf
+        body_leaves = [n for n in nodes
+                       if not n["children"] and n.get("label") == "A"]
+        assert len(body_leaves) >= 2  # extends-clause A is absent; qualifiers remain
+
+    def test_tokenize(self, lib):
+        toks = lib.tokenize("int x = foo(1, \"s\");")
+        assert toks == ["int", "x", "=", "foo", "(", "1", ",", '"s"', ")", ";"]
+
+
+class TestDiffContract:
+    def test_every_line_well_formed(self, lib):
+        for ln in lib.diff_lines(OLD_SRC, NEW_SRC):
+            assert ACTION_RE.match(ln), ln
+
+    def test_identity_diff_is_all_match(self, lib):
+        lines = lib.diff_lines(OLD_SRC, OLD_SRC)
+        assert lines and all(ln.startswith("Match") for ln in lines)
+        # every node of the tree is matched
+        n_nodes = sum(1 for _ in iter_nodes(lib.parse_json(OLD_SRC)["root"]))
+        assert len(lines) == n_nodes
+
+    def test_update_and_move_old_nodes_also_matched(self, lib):
+        # The reference reclassifies Match lines by joining them against the
+        # Update/Move lists on the OLD node (get_ast_root_action.py:188-222)
+        # and asserts every Update/Move is consumed (:224-225) — so each
+        # Update/Move old node must appear in some Match line.
+        lines = lib.diff_lines(OLD_SRC, NEW_SRC)
+        matched_old = set()
+        for ln in lines:
+            if ln.startswith("Match "):
+                old, _ = ln[len("Match "):].rsplit(" to ", 1)
+                matched_old.add(parse_actor(old)[2])
+        for ln in lines:
+            if ln.startswith("Update "):
+                old, _ = ln[len("Update "):].rsplit(" to ", 1)
+                assert parse_actor(old)[2] in matched_old, ln
+            elif ln.startswith("Move "):
+                old, _ = ln[len("Move "):].split(" into ", 1)
+                assert parse_actor(old)[2] in matched_old, ln
+
+    def test_insert_move_targets_own_child(self, lib):
+        # Reference asserts the named child is really among the parent's
+        # children in the NEW tree (get_ast_root_action.py:207-208,226-231).
+        lines = lib.diff_lines(OLD_SRC, NEW_SRC)
+        new_ast = lib.parse_json(NEW_SRC)
+        children_of = {
+            n["id"]: {c["id"] for c in n["children"]}
+            for n in iter_nodes(new_ast["root"])
+        }
+        match_o2n = {}
+        for ln in lines:
+            if ln.startswith("Match "):
+                old, new = ln[len("Match "):].rsplit(" to ", 1)
+                match_o2n[parse_actor(old)[2]] = parse_actor(new)[2]
+        for ln in lines:
+            if ln.startswith("Insert "):
+                rest = ln[len("Insert "):]
+                child, tail = rest.split(" into ", 1)
+                parent, _ = tail.rsplit(" at ", 1)
+                assert parse_actor(child)[2] in children_of[parse_actor(parent)[2]], ln
+            elif ln.startswith("Move "):
+                rest = ln[len("Move "):]
+                old_child, tail = rest.split(" into ", 1)
+                parent, _ = tail.rsplit(" at ", 1)
+                new_child = match_o2n[parse_actor(old_child)[2]]
+                assert new_child in children_of[parse_actor(parent)[2]], ln
+
+    def test_update_with_whitespace_label_splits_like_bridge(self, lib):
+        # String-literal edits produce multi-word new names; the bridge's
+        # `split(' to ')` must still yield exactly two parts.
+        old = 'class A { String s = "a"; }'
+        new = 'class A { String s = "a b"; }'
+        ups = [ln for ln in lib.diff_lines(old, new) if ln.startswith("Update ")]
+        assert ups, "expected an Update for the literal edit"
+        for ln in ups:
+            parts = ln[len("Update "):].split(" to ")
+            assert len(parts) == 2, ln
+            assert parts[1] == '"a b"'
+
+    def test_detects_rename_as_update(self, lib):
+        old = "class A { int foo() { return 1; } }"
+        new = "class A { int bar() { return 1; } }"
+        lines = lib.diff_lines(old, new)
+        ups = [ln for ln in lines if ln.startswith("Update ")]
+        assert any("foo" in ln and ln.endswith("bar") for ln in ups), lines
+
+    def test_pure_insert_and_delete(self, lib):
+        old = "class A { int x; }"
+        new = "class A { int x; int y; }"
+        lines = lib.diff_lines(old, new)
+        assert any(ln.startswith("Insert ") for ln in lines)
+        lines_rev = lib.diff_lines(new, old)
+        assert any(ln.startswith("Delete ") for ln in lines_rev)
+
+
+class TestCliContract:
+    """The subprocess surface (drop-in for `gumtree parse|diff`)."""
+
+    def test_cli_parse_matches_library(self, lib, tmp_path):
+        f = tmp_path / "A.java"
+        f.write_text(OLD_SRC)
+        out = subprocess.run([ad.CLI_PATH, "parse", str(f)],
+                             capture_output=True, text=True, check=True)
+        assert json.loads(out.stdout) == lib.parse_json(OLD_SRC)
+
+    def test_cli_diff_matches_library(self, lib, tmp_path):
+        a, b = tmp_path / "A.java", tmp_path / "B.java"
+        a.write_text(OLD_SRC)
+        b.write_text(NEW_SRC)
+        out = subprocess.run([ad.CLI_PATH, "diff", str(a), str(b)],
+                             capture_output=True, text=True, check=True)
+        got = [ln for ln in out.stdout.splitlines() if ln.strip()]
+        assert got == lib.diff_lines(OLD_SRC, NEW_SRC)
