@@ -56,6 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--beam-log-space", action="store_true",
                    help="log-space beam accumulation instead of the "
                         "reference-compat probability space")
+    p.add_argument("--shard-size", type=int, default=100,
+                   help="preprocess: commits per worker shard (reference "
+                        "each_num=100)")
+    p.add_argument("--num-procs", type=int, default=None,
+                   help="preprocess: worker processes (default: cpu count)")
     return p
 
 

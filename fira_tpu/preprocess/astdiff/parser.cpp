@@ -1061,6 +1061,7 @@ class Parser {
   }
 
   Node* parse_assignment() {
+    DepthGuard dg(*this);
     size_t s = mark();
     Node* lhs = parse_conditional();
     if (at_assign_op()) {
@@ -1077,6 +1078,7 @@ class Parser {
   }
 
   Node* parse_conditional() {
+    DepthGuard dg(*this);
     size_t s = mark();
     Node* c = parse_binary(0);
     if (at_op("?")) {

@@ -44,13 +44,17 @@ class AstdiffBuildError(RuntimeError):
 
 
 def _stale() -> bool:
-    if not os.path.exists(LIB_PATH):
-        return True
-    lib_mtime = os.path.getmtime(LIB_PATH)
-    return any(
-        os.path.getmtime(os.path.join(ASTDIFF_DIR, s)) > lib_mtime
-        for s in _SOURCES if os.path.exists(os.path.join(ASTDIFF_DIR, s))
-    )
+    # Both artifacts must exist and be newer than every source — the CLI is
+    # the differential-testing surface and must never lag the library.
+    for target in (LIB_PATH, CLI_PATH):
+        if not os.path.exists(target):
+            return True
+        mtime = os.path.getmtime(target)
+        if any(os.path.getmtime(os.path.join(ASTDIFF_DIR, s)) > mtime
+               for s in _SOURCES
+               if os.path.exists(os.path.join(ASTDIFF_DIR, s))):
+            return True
+    return False
 
 
 def build(force: bool = False) -> str:

@@ -119,6 +119,11 @@ class TestParseContract:
         assert lib.parse_json(deep_ann) is None
         deep_enum = "enum E { ; " * 20000 + "}" * 20000
         assert lib.parse_json(deep_enum) is None
+        deep_assign = ("class A { void f() { x = " + "x = " * 100000
+                       + "1; } }")
+        assert lib.parse_json(deep_assign) is None
+        deep_ternary = ("class A { int x = " + "1 ? 1 : " * 100000 + "1; }")
+        assert lib.parse_json(deep_ternary) is None
         # bounded nesting still parses
         ok = "class A { int x = " + "(" * 50 + "1" + ")" * 50 + "; }"
         assert lib.parse_json(ok) is not None

@@ -1,0 +1,217 @@
+"""Tests for the AST/graph extraction worker (reference
+process_data_ast_parallel.py semantics; its inline asserts are the spec)."""
+
+import pytest
+
+from fira_tpu.preprocess import extract
+from fira_tpu.preprocess.fsm import split_hunks
+
+
+class TestBalanceBrackets:
+    def test_leading_close_dropped(self):
+        assert extract.balance_brackets(["}", "a"]) == ["a"]
+
+    def test_unmatched_open_closed_at_end(self):
+        assert extract.balance_brackets(["if", "(", "x", ")", "{", "y", ";"]) \
+            == ["if", "(", "x", ")", "{", "y", ";", "}"]
+
+    def test_unmatched_close_opened_at_front(self):
+        assert extract.balance_brackets(["a", ";", "}", "}"]) \
+            == ["{", "{", "a", ";", "}", "}"]
+
+    def test_balanced_untouched(self):
+        toks = ["{", "a", ";", "}"]
+        assert extract.balance_brackets(toks) == toks
+
+
+class TestReconstruct:
+    def test_statement_gets_class_and_block_shell(self):
+        text, start = extract.reconstruct_java(["x", "=", "1", ";"])
+        assert text.startswith("class pad_pad_class { {")
+        assert text[start:].startswith("x = 1 ;")
+
+    def test_method_def_gets_class_shell(self):
+        text, start = extract.reconstruct_java(
+            ["public", "int", "f", "(", ")", "{", "return", "1", ";", "}"])
+        assert text.startswith("class pad_pad_class {")
+        assert text[start:].startswith("public int f")
+
+    def test_header_only_method_gets_empty_body(self):
+        text, _ = extract.reconstruct_java(["public", "void", "g", "(", ")"])
+        assert text.endswith("{ } }")
+
+    def test_field_def_gets_double_shell(self):
+        text, start = extract.reconstruct_java(
+            ["private", "int", "x", "=", "1", ";"])
+        assert text.startswith("class pad_pad_class { {")
+        assert text[start:].startswith("private int x")
+
+    def test_class_def_unwrapped(self):
+        text, start = extract.reconstruct_java(
+            ["public", "class", "A", "{", "}"])
+        assert start == 0
+        assert text == "public class A { }"
+
+    def test_import_unwrapped(self):
+        text, start = extract.reconstruct_java(["import", "a", ".", "b", ";"])
+        assert start == 0
+
+    def test_sentinels_and_comments_stripped(self):
+        text, _ = extract.reconstruct_java(
+            ["<nb>", "x", "=", "1", ";", "COMMENT", "<nl>"])
+        assert "COMMENT" not in text and "<nb>" not in text
+
+    def test_empty_after_cleaning(self):
+        assert extract.reconstruct_java(["COMMENT", "<nl>"]) is None
+
+    def test_if_without_braces_closed(self):
+        text, _ = extract.reconstruct_java(["if", "(", "x", ")"])
+        assert "{ }" in text
+
+    def test_all_fragments_parse(self):
+        cases = [
+            ["x", "=", "compute", "(", "y", ")", ";"],
+            ["public", "int", "f", "(", ")", "{", "return", "1", ";", "}"],
+            ["private", "int", "x", "=", "1", ";"],
+            ["public", "class", "A", "{", "}"],
+            ["import", "a", ".", "b", ";"],
+            ["{", "y", "++", ";", "}"],
+            ["if", "(", "x", ")"],
+            ["@", "Override", "public", "void", "g", "(", ")", "{", "}"],
+        ]
+        for toks in cases:
+            text, side = extract.parse_fragment(toks)
+            assert text is not None, toks
+
+
+class TestAstCodeEdges:
+    def test_leaves_map_to_token_positions(self):
+        toks = ["x", "=", "compute", "(", "y", ")", ";"]
+        _, side = extract.parse_fragment(toks)
+        mapped = sorted(side.dmap_code.values())
+        # x, =, compute, y are AST-relevant tokens; punctuation has no leaf
+        assert set(mapped) <= set(range(len(toks)))
+        names = {toks[j] for j in mapped}
+        assert {"x", "compute", "y"} <= names
+
+    def test_wrapper_shell_contributes_no_nodes(self):
+        toks = ["x", "=", "1", ";"]
+        _, side = extract.parse_fragment(toks)
+        assert "TypeDeclaration" not in side.ast_tokens
+        assert "CompilationUnit" not in side.ast_tokens
+        # pad_pad_class never appears as a mapped code token
+        assert all(0 <= j < len(toks) for j in side.dmap_code.values())
+
+    def test_repeated_token_maps_in_order(self):
+        toks = ["x", "=", "x", "+", "x", ";"]
+        _, side = extract.parse_fragment(toks)
+        xs = sorted(j for j in side.dmap_code.values() if toks[j] == "x")
+        assert xs == [0, 2, 4]
+
+    def test_one_code_token_per_leaf(self):
+        toks = ["foo", "(", "foo", "(", "bar", ")", ")", ";"]
+        _, side = extract.parse_fragment(toks)
+        used = list(side.dmap_code.values())
+        assert len(used) == len(set(used))
+
+    def test_ast_edges_are_parent_child(self):
+        toks = ["public", "int", "f", "(", ")", "{", "return", "1", ";", "}"]
+        _, side = extract.parse_fragment(toks)
+        n = len(side.ast_tokens)
+        for a1, a2 in side.edge_ast:
+            assert 0 <= a1 < n and 0 <= a2 < n
+        for a, j in side.edge_ast_code:
+            assert 0 <= a < n and 0 <= j < len(toks)
+
+
+class TestUpdateChunk:
+    def test_rename_produces_update_change(self):
+        old = ["x", "=", "compute", "(", ")", ";"]
+        new = ["y", "=", "compute", "(", ")", ";"]
+        g = extract.update_chunk_edges(old, new)
+        assert "update" in g.change
+        # the update change node touches code on both sides
+        cs = {c for c, _ in g.edge_change_code_old}
+        assert cs & {c for c, _ in g.edge_change_code_new}
+
+    def test_pure_rewrite_produces_add_delete(self):
+        old = ["x", "=", "1", ";"]
+        new = ["x", "=", "1", ";", "y", "=", "2", ";"]
+        g = extract.update_chunk_edges(old, new)
+        assert "add" in g.change
+
+    def test_unparseable_side_degrades(self):
+        # One side failing drops the change nodes, but the parseable side
+        # keeps its AST edges (reference get_edge_update:201-217).
+        g = extract.update_chunk_edges(["COMMENT"], ["x", "=", "1", ";"])
+        assert g.change == []
+        assert g.old.ast_tokens == []
+        assert g.new.ast_tokens != []
+
+    def test_change_indices_dense(self):
+        old = ["x", "=", "compute", "(", ")", ";"]
+        new = ["z", "=", "compute", "(", "1", ")", ";"]
+        g = extract.update_chunk_edges(old, new)
+        touched = {c for c, _ in (g.edge_change_code_old
+                                  + g.edge_change_ast_old
+                                  + g.edge_change_code_new
+                                  + g.edge_change_ast_new)}
+        assert touched == set(range(len(g.change)))
+
+
+class TestExtractCommit:
+    def _commit(self):
+        # context header, then an update hunk renaming a variable
+        tokens = (["<nb>", "file", "<nl>"]
+                  + ["int", "x", "=", "1", ";"]       # delete
+                  + ["int", "y", "=", "1", ";"]       # add
+                  + ["return", ";"])                  # context
+        marks = [2, 2, 2] + [1] * 5 + [3] * 5 + [2, 2]
+        return tokens, marks
+
+    def test_streams_and_invariant(self):
+        tokens, marks = self._commit()
+        chunks, types = split_hunks(tokens, marks)
+        g = extract.extract_commit(chunks, types, tokens)
+        assert 100 in types
+        assert g.change, "update hunk must produce change nodes"
+        assert set(g.change) <= {"match", "update", "move", "delete", "add"}
+        n_ast, n_change = len(g.ast), len(g.change)
+        for a1, a2 in g.edge_ast:
+            assert 0 <= a1 < n_ast and 0 <= a2 < n_ast
+        for a, j in g.edge_ast_code:
+            assert 0 <= a < n_ast and 0 <= j < len(tokens)
+            assert tokens[j] not in ("<nb>", "<nl>")
+        for c, j in g.edge_change_code:
+            assert 0 <= c < n_change and 0 <= j < len(tokens)
+        for c, a in g.edge_change_ast:
+            assert 0 <= c < n_change and 0 <= a < n_ast
+
+    def test_update_links_old_and_new_positions(self):
+        tokens, marks = self._commit()
+        chunks, types = split_hunks(tokens, marks)
+        g = extract.extract_commit(chunks, types, tokens)
+        # the matched 'int' / '1' / '=' structure means some change node is
+        # wired to both the delete-run and add-run token ranges
+        del_range = range(3, 8)
+        add_range = range(8, 13)
+        by_change = {}
+        for c, j in g.edge_change_code:
+            by_change.setdefault(c, []).append(j)
+        assert any(
+            any(j in del_range for j in js) and any(j in add_range for j in js)
+            for js in by_change.values()
+        )
+
+    def test_token_stream_mismatch_raises(self):
+        tokens, marks = self._commit()
+        chunks, types = split_hunks(tokens, marks)
+        with pytest.raises(extract.ExtractError):
+            extract.extract_commit(chunks, types, tokens + ["extra"])
+
+    def test_degenerate_commit_all_context(self):
+        tokens = ["<nb>", "f", "<nl>", "return", ";"]
+        marks = [2] * 5
+        chunks, types = split_hunks(tokens, marks)
+        g = extract.extract_commit(chunks, types, tokens)
+        assert g.change == [] and g.edge_change_code == []

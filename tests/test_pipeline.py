@@ -1,0 +1,122 @@
+"""End-to-end preprocessing pipeline tests: raw diff streams -> shard
+fan-out -> gather -> corpus -> dataset -> model forward."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fira_tpu.preprocess import pipeline
+
+
+def _raw_corpus():
+    """Three commits: an update hunk, a pure addition, all-context."""
+    commits = []
+    # commit 0: rename x -> y in an assignment
+    t0 = (["<nb>", "Foo.java", "<nl>"]
+          + ["int", "x", "=", "compute", "(", ")", ";"]
+          + ["int", "y", "=", "compute", "(", ")", ";"]
+          + ["return", ";"])
+    m0 = [2, 2, 2] + [1] * 7 + [3] * 7 + [2, 2]
+    commits.append((t0, m0, ["rename", "variable"]))
+    # commit 1: pure addition of a method
+    t1 = (["<nb>", "Bar.java", "<nl>"]
+          + ["public", "void", "doWork", "(", ")", "{", "}"])
+    m1 = [2, 2, 2] + [3] * 7
+    commits.append((t1, m1, ["add", "doWork", "method"]))
+    # commit 2: context only
+    t2 = ["<nb>", "Baz.java", "<nl>", "return", ";"]
+    m2 = [2] * 5
+    commits.append((t2, m2, ["noop"]))
+    return commits
+
+
+@pytest.fixture
+def raw_dir(tmp_path):
+    commits = _raw_corpus()
+    d = tmp_path / "ds"
+    d.mkdir()
+    (d / "difftoken.json").write_text(json.dumps([c[0] for c in commits]))
+    (d / "diffmark.json").write_text(json.dumps([c[1] for c in commits]))
+    (d / "msg.json").write_text(json.dumps([c[2] for c in commits]))
+    (d / "variable.json").write_text(json.dumps([{} for _ in commits]))
+    return str(d)
+
+
+class TestSubTokens:
+    def test_camel_and_snake_split(self):
+        assert pipeline.split_sub_tokens("doWork") == ["do", "work"]
+        assert pipeline.split_sub_tokens("max_value") == ["max", "value"]
+        assert pipeline.split_sub_tokens("HTTPServer") == ["http", "server"]
+
+    def test_single_word_and_non_ident_empty(self):
+        assert pipeline.split_sub_tokens("return") == []
+        assert pipeline.split_sub_tokens(";") == []
+        assert pipeline.split_sub_tokens("<nb>") == []
+
+    def test_placeholders_empty(self):
+        assert pipeline.split_sub_tokens("STRING0") == []
+        assert pipeline.split_sub_tokens("NUMBER3") == []
+
+    def test_subtokens_are_lowercase(self):
+        for tok in ("getHTTPResponseCode", "snake_caseMix", "A_B"):
+            for part in pipeline.split_sub_tokens(tok):
+                assert part.islower()
+
+
+class TestPipeline:
+    def test_end_to_end_streams(self, raw_dir):
+        report = pipeline.run_pipeline(raw_dir, shard_size=2, num_procs=2)
+        assert report.n_commits == 3
+        assert report.n_shards == 2
+        assert report.n_errors == 0
+        for s in pipeline.GRAPH_STREAMS:
+            data = json.load(open(os.path.join(raw_dir, f"{s}.json")))
+            assert len(data) == 3
+        change = json.load(open(os.path.join(raw_dir, "change.json")))
+        assert change[0], "update commit must have change nodes"
+        assert change[2] == [], "context-only commit has none"
+        atts = json.load(open(os.path.join(raw_dir, "diffatt.json")))
+        assert atts[1][5] == ["do", "work"]  # doWork
+        assert os.path.exists(os.path.join(raw_dir, "word_vocab.json"))
+        assert os.path.exists(os.path.join(raw_dir, "ast_change_vocab.json"))
+
+    def test_idempotent_rerun_skips_shards(self, raw_dir):
+        pipeline.run_pipeline(raw_dir, shard_size=2, num_procs=1)
+        report = pipeline.run_pipeline(raw_dir, shard_size=2, num_procs=1)
+        assert report.skipped_shards == 2
+
+    def test_bad_commit_degrades_not_aborts(self, raw_dir):
+        # corrupt commit 1's marks so the FSM rejects it
+        marks = json.load(open(os.path.join(raw_dir, "diffmark.json")))
+        marks[1] = [9] * len(marks[1])
+        json.dump(marks, open(os.path.join(raw_dir, "diffmark.json"), "w"))
+        report = pipeline.run_pipeline(raw_dir, shard_size=2, num_procs=1)
+        assert report.n_errors == 1
+        ast = json.load(open(os.path.join(raw_dir, "ast.json")))
+        assert len(ast) == 3 and ast[1] == []
+        errs = json.load(open(os.path.join(
+            raw_dir, "shards", "shard_0_2", "errors.json")))
+        assert errs[0]["commit"] == 1
+
+    def test_corpus_feeds_dataset_and_model(self, raw_dir):
+        import jax
+
+        from fira_tpu.config import fira_tiny
+        from fira_tpu.data.batching import make_batch
+        from fira_tpu.data.dataset import FiraDataset
+        from fira_tpu.model.model import FiraModel
+
+        pipeline.run_pipeline(raw_dir, shard_size=100, num_procs=1)
+        cfg = fira_tiny(batch_size=2)
+        ds = FiraDataset(raw_dir, cfg)  # 3 commits -> 1/1/1 split
+        cfg = ds.cfg
+        train = ds.splits["train"]
+        batch = make_batch(train, np.arange(len(train)), cfg, batch_size=2)
+        model = FiraModel(cfg)
+        params = model.init(jax.random.PRNGKey(0), batch,
+                            deterministic=True)["params"]
+        nll_sum, count = model.apply({"params": params}, batch,
+                                     deterministic=True)
+        assert np.isfinite(float(nll_sum / count))
