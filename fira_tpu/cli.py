@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "each_num=100)")
     p.add_argument("--num-procs", type=int, default=None,
                    help="preprocess: worker processes (default: cpu count)")
+    p.add_argument("--profile-dir", default=None,
+                   help="train: write a jax.profiler trace of a steady-state "
+                        "step window here (TensorBoard-loadable)")
     return p
 
 
@@ -136,7 +139,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         result = train(
             dataset, cfg, mesh=mesh, out_dir=args.out_dir,
             ckpt_dir=ckpt_dir, epochs=args.epochs, var_maps=var_maps,
-            resume=not args.no_resume,
+            resume=not args.no_resume, profile_dir=args.profile_dir,
         )
         print(f"best dev bleu: {result.best_bleu:.4f}  "
               f"throughput: {result.commits_per_sec_per_chip:.1f} "

@@ -205,7 +205,8 @@ class SideGraph:
     dmap_code: Dict[int, int]             # leaf node id -> code_token_idx
 
 
-EMPTY_SIDE = SideGraph([], [], [], {}, {})
+def empty_side() -> SideGraph:
+    return SideGraph([], [], [], {}, {})
 
 
 def ast_code_edges(nodes: List[AstNode], codes: Sequence[str],
@@ -285,11 +286,11 @@ def parse_fragment(code_tokens: Sequence[str], *,
     text is None when the fragment doesn't parse (side is then empty)."""
     recon = reconstruct_java(code_tokens)
     if recon is None:
-        return None, EMPTY_SIDE
+        return None, empty_side()
     text, start = recon
     parsed = astdiff.parse_json(text)
     if parsed is None:
-        return None, EMPTY_SIDE
+        return None, empty_side()
     nodes = build_tree(parsed)
     return text, ast_code_edges(nodes, code_tokens, start,
                                 commit_index=commit_index)
@@ -393,10 +394,8 @@ class ChunkGraph:
     indices relative to the new side's own ast list; ``change`` labels are
     shared across both sides."""
 
-    old: SideGraph = dataclasses.field(default_factory=lambda: SideGraph(
-        [], [], [], {}, {}))
-    new: SideGraph = dataclasses.field(default_factory=lambda: SideGraph(
-        [], [], [], {}, {}))
+    old: SideGraph = dataclasses.field(default_factory=empty_side)
+    new: SideGraph = dataclasses.field(default_factory=empty_side)
     change: List[str] = dataclasses.field(default_factory=list)
     edge_change_code_old: List[Tuple[int, int]] = dataclasses.field(
         default_factory=list)

@@ -125,7 +125,13 @@ def _run_shard(job: Tuple[str, int, int, list, list]) -> Tuple[int, int, int]:
     of the streams, so corpus-sized JSON is parsed exactly once."""
     out_dir, begin, end, difftokens, diffmarks = job
     if _shard_done(out_dir, begin, end):
-        return begin, end, -1  # already done (idempotent re-run)
+        # idempotent re-run: report the errors recorded when the shard ran,
+        # so re-runs don't claim a clean corpus that isn't
+        err_path = os.path.join(_shard_dir(out_dir, begin, end), "errors.json")
+        if os.path.exists(err_path):
+            with open(err_path) as f:
+                return begin, end, len(json.load(f))
+        return begin, end, 0
     streams, errors = process_commits(difftokens, diffmarks, 0,
                                       end - begin, index_offset=begin)
     d = _shard_dir(out_dir, begin, end)
@@ -170,7 +176,6 @@ def run_pipeline(data_dir: str, *, out_dir: Optional[str] = None,
     skipped = sum(1 for j in jobs if _shard_done(out_dir, j[1], j[2]))
 
     num_procs = num_procs or min(len(jobs), os.cpu_count() or 1)
-    n_errors = 0
     if num_procs <= 1 or len(jobs) <= 1:
         results = [_run_shard(j) for j in jobs]
     else:
@@ -179,7 +184,7 @@ def run_pipeline(data_dir: str, *, out_dir: Optional[str] = None,
         ctx = multiprocessing.get_context("spawn")
         with ctx.Pool(num_procs) as pool:
             results = pool.map(_run_shard, jobs)
-    n_errors = sum(r[2] for r in results if r[2] > 0)
+    n_errors = sum(r[2] for r in results)
 
     gather(out_dir, n, shard_size=shard_size)
 

@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import time
 from typing import Dict, Iterator, List, Optional
 
 import jax
@@ -38,6 +37,7 @@ from fira_tpu.model.model import FiraModel
 from fira_tpu.parallel import mesh as pmesh
 from fira_tpu.train import step as step_lib
 from fira_tpu.train.state import CheckpointManager, TrainState, init_state
+from fira_tpu.utils import profiling
 
 
 @dataclasses.dataclass
@@ -109,6 +109,8 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
           epochs: Optional[int] = None,
           var_maps: Optional[List[Dict[str, str]]] = None,
           resume: bool = True,
+          profile_dir: Optional[str] = None,
+          profile_steps: int = 10,
           dtype=None) -> TrainResult:
     """Full training run. ``mesh=None`` => single-chip jit; otherwise the
     (data, model) mesh from parallel.mesh with XLA-inserted collectives."""
@@ -138,26 +140,29 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
 
     n_epochs = epochs if epochs is not None else cfg.epochs
     n_chips = 1 if mesh is None else mesh.devices.size
-    timed_commits = 0
-    timed_seconds = 0.0
     # The host only syncs with the device at logging/dev boundaries — steps
     # stay asynchronously dispatched in between (the per-step .item() sync is
-    # one of the reference's throughput sins to avoid). The interval that
-    # includes the first (compile) step is excluded from the meter.
+    # one of the reference's throughput sins to avoid). Meter(warmup=1) drops
+    # the interval containing the compile step.
+    meter = profiling.Meter(warmup=1)
     pending_commits = 0
-    seen_first_interval = False
-    t_sync = time.perf_counter()
+    meter.start()
 
-    def sync_meter(include: bool = True):
-        nonlocal pending_commits, t_sync, timed_commits, timed_seconds
-        nonlocal seen_first_interval
-        now = time.perf_counter()
-        if include and seen_first_interval and pending_commits:
-            timed_commits += pending_commits
-            timed_seconds += now - t_sync
-        seen_first_interval = True
-        pending_commits = 0
-        t_sync = now
+    def sync_tick():
+        """Record the interval since the last sync, attributing the commits
+        dispatched in it; an empty interval just restarts the clock."""
+        nonlocal pending_commits
+        if pending_commits:
+            meter.tick(pending_commits)
+            pending_commits = 0
+        else:
+            meter.start()
+
+    # jax.profiler trace of a steady-state step window (skips the compile
+    # step); viewable in TensorBoard / xprof.
+    profile_window = (range(2, 2 + profile_steps) if profile_dir else range(0))
+    profiling_active = False
+    global_step = 0
 
     for epoch in range(start_epoch, n_epochs):
         last_metrics = None
@@ -169,7 +174,8 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                     and idx % cfg.dev_every_batches == 0):
                 if last_metrics is not None:
                     jax.block_until_ready(last_metrics["loss"])
-                sync_meter()
+                sync_tick()
+                meter.pause()  # dev time is not train time
                 cur_bleu, dev_text = run_dev(dev_step, state.params, dataset,
                                              cfg, var_maps)
                 better = cur_bleu > best_bleu
@@ -178,20 +184,42 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                     best_bleu = cur_bleu
                     ckpt.save_best(state.params)
                     log.dev_output(dev_text)
-                t_sync = time.perf_counter()  # dev time is not train time
+                meter.start()
 
-            state, metrics = train_step(state, batch)
+            if profile_window and global_step == profile_window[0]:
+                jax.profiler.start_trace(profile_dir)
+                profiling_active = True
+            if profiling_active:
+                with profiling.step_annotation(global_step):
+                    state, metrics = train_step(state, batch)
+                if global_step == profile_window[-1]:
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    profiling_active = False
+                    log.console(f"profile trace written to {profile_dir}")
+            else:
+                state, metrics = train_step(state, batch)
+            global_step += 1
             last_metrics = metrics
             pending_commits += int(np.asarray(batch["valid"]).sum())
             if idx % 10 == 0:
                 loss = float(jax.device_get(metrics["loss"]))  # blocks
-                sync_meter()
+                sync_tick()
                 log.console(f"epoch: {epoch} batch: {idx} loss: {loss:.4f}")
         if last_metrics is not None:
             jax.block_until_ready(last_metrics["loss"])
-        sync_meter()
+        sync_tick()
         ckpt.save_latest(state, best_bleu=best_bleu, epoch=epoch + 1)
 
-    cps = (timed_commits / timed_seconds / n_chips) if timed_seconds else 0.0
+    if profiling_active:  # run ended inside the profile window
+        jax.profiler.stop_trace()
+        log.console(f"profile trace written to {profile_dir}")
+    elif profile_dir and global_step <= (profile_window[0] if profile_window
+                                         else 0):
+        log.console(f"profile trace NOT written: run ended after "
+                    f"{global_step} steps, before the profile window "
+                    f"(starts at step {profile_window[0]})")
+
+    cps = meter.summary()["items_per_sec"] / n_chips
     return TrainResult(state=state, best_bleu=best_bleu, epochs_run=n_epochs,
                        commits_per_sec_per_chip=cps)

@@ -1,0 +1,92 @@
+"""Tracing and step-timing hooks.
+
+The reference has no profiling at all — an unused ``import time`` and
+step-rate prints (/root/reference/run_model.py:114-115,181-182). Here:
+
+- ``trace(log_dir)``: context manager around ``jax.profiler`` producing a
+  TensorBoard-loadable XPlane trace of everything inside it;
+- ``step_annotation(step)``: names each training step in the trace so device
+  timelines line up with host steps;
+- ``Meter``: windowed wall-clock meter for steady-state throughput
+  (items/sec) and step latency percentiles, excluding warm-up/compile steps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Dict, Iterator, List, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Profile everything inside the block to ``log_dir`` (no-op if None)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step_annotation(step: int):
+    """Label the current host step on the device timeline."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
+
+
+@dataclasses.dataclass
+class Meter:
+    """Steady-state throughput/latency meter.
+
+    ``warmup`` leading intervals are discarded (they contain compilation).
+    Call ``tick(n_items)`` once per completed step after syncing with the
+    device; read ``summary()`` at the end.
+    """
+
+    warmup: int = 1
+    _intervals: List[float] = dataclasses.field(default_factory=list)
+    _items: List[int] = dataclasses.field(default_factory=list)
+    _last: Optional[float] = None
+    _seen: int = 0
+
+    def start(self) -> None:
+        self._last = time.perf_counter()
+
+    def pause(self) -> None:
+        """Exclude the time until the next start() (e.g. a dev-eval pass)."""
+        self._last = None
+
+    def tick(self, n_items: int = 1) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._seen += 1
+            if self._seen > self.warmup:
+                self._intervals.append(now - self._last)
+                self._items.append(n_items)
+        self._last = now
+
+    def summary(self) -> Dict[str, float]:
+        if not self._intervals:
+            return {"steps": 0, "items_per_sec": 0.0,
+                    "mean_step_ms": 0.0, "p50_step_ms": 0.0,
+                    "p99_step_ms": 0.0}
+        total_t = sum(self._intervals)
+        xs = sorted(self._intervals)
+
+        def pct(p: float) -> float:
+            return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+        return {
+            "steps": float(len(xs)),
+            "items_per_sec": sum(self._items) / total_t,
+            "mean_step_ms": 1e3 * total_t / len(xs),
+            "p50_step_ms": 1e3 * pct(0.50),
+            "p99_step_ms": 1e3 * pct(0.99),
+        }

@@ -1,0 +1,54 @@
+"""Profiling hooks: trace context produces a loadable artifact; Meter math."""
+
+import glob
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+from fira_tpu.utils import profiling
+
+
+class TestTrace:
+    def test_trace_writes_artifacts(self, tmp_path):
+        log_dir = str(tmp_path / "trace")
+        with profiling.trace(log_dir):
+            with profiling.step_annotation(0):
+                jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+        files = glob.glob(os.path.join(log_dir, "**", "*"), recursive=True)
+        assert any(f.endswith(".xplane.pb") for f in files), files
+
+    def test_trace_none_is_noop(self):
+        with profiling.trace(None):
+            pass  # must not require jax profiler state
+
+
+class TestMeter:
+    def test_throughput_and_warmup(self):
+        m = profiling.Meter(warmup=1)
+        m.start()
+        for _ in range(4):
+            time.sleep(0.01)
+            m.tick(n_items=5)
+        s = m.summary()
+        # first interval (warmup) discarded: 3 measured steps
+        assert s["steps"] == 3
+        assert s["items_per_sec"] > 0
+        assert s["p50_step_ms"] >= 10 * 0.5
+        assert s["p99_step_ms"] >= s["p50_step_ms"]
+
+    def test_pause_excludes_interval(self):
+        m = profiling.Meter(warmup=0)
+        m.start()
+        m.tick()
+        m.pause()
+        time.sleep(0.05)  # excluded
+        m.start()
+        m.tick()
+        s = m.summary()
+        assert s["steps"] == 2
+        assert s["p99_step_ms"] < 50
+
+    def test_empty_summary(self):
+        assert profiling.Meter().summary()["steps"] == 0
