@@ -33,7 +33,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--config", default="fira-full",
                    help="named config: fira-tiny | fira-full | fira-large")
     p.add_argument("--ablation", default=None,
-                   choices=[None, "no_edit", "no_subtoken", "nothing"],
+                   choices=["no_edit", "no_subtoken", "nothing"],
                    help="paper Table 3 ablations")
     p.add_argument("--data-dir", default="DataSet",
                    help="corpus directory (reference DataSet/ layout)")
@@ -51,7 +51,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", default=None, metavar="DPxTP",
                    help="device mesh, e.g. 4x1 (data x model); default: all "
                         "devices on the data axis")
-    p.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"],
+    p.add_argument("--dtype", default=None, choices=["float32", "bfloat16"],
                    help="compute dtype override (params stay f32)")
     p.add_argument("--beam-log-space", action="store_true",
                    help="log-space beam accumulation instead of the "
@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "each_num=100)")
     p.add_argument("--num-procs", type=int, default=None,
                    help="preprocess: worker processes (default: cpu count)")
+    p.add_argument("--adjacency", default=None,
+                   choices=["dense", "segment"],
+                   help="GCN message passing: dense bmm (default) or "
+                        "O(edges) COO segment-sum for larger graphs")
     p.add_argument("--profile-dir", default=None,
                    help="train: write a jax.profiler trace of a steady-state "
                         "step window here (TensorBoard-loadable)")
@@ -81,6 +85,8 @@ def _resolve_cfg(args):
         overrides["compute_dtype"] = args.dtype
     if args.beam_log_space:
         overrides["beam_compat_prob_space"] = False
+    if args.adjacency:
+        overrides["adjacency_impl"] = args.adjacency
     return cfg.replace(**overrides) if overrides else cfg
 
 

@@ -55,6 +55,11 @@ class FiraConfig:
     # Dataset.py:336-343 — its biggest throughput sin). Densification to a
     # batch of graph_len^2 happens once per step inside the jitted program.
     max_edges: int = 8192       # padded COO length per sample (measured p100 < 6k)
+    # "dense": scatter COO into a (B, graph_len^2) adjacency once per step and
+    #   run the GCN as a bmm (MXU-friendly at the reference's 650 nodes);
+    # "segment": gather/scatter message passing directly on the COO triplets —
+    #   O(edges) memory, the path that scales past the 650-node geometry.
+    adjacency_impl: str = "dense"
 
     # --- precision ---
     # Compute dtype for matmuls/attention. Params and the fused output

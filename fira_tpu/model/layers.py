@@ -126,9 +126,9 @@ class Combination(nn.Module):
 class GCN(nn.Module):
     """One graph-convolution round (gnn_transformer.py:64-86):
     fc1 -> A.x -> fc2 -> dropout(0.2) + residual -> LayerNorm, over the
-    shared normalized adjacency. The adjacency arrives dense per batch
-    (scattered once per step from COO) so the message passing is a single
-    MXU-friendly bmm."""
+    shared normalized adjacency. ``adj`` is either a dense (B, N, N) batch
+    (one MXU bmm) or a callable applying A.x directly from COO triplets
+    (model.coo_matvec, the O(edges) path for large graphs)."""
 
     d_model: int
     dropout_rate: float = 0.2
@@ -137,7 +137,10 @@ class GCN(nn.Module):
     @nn.compact
     def __call__(self, graph_em, adj, *, deterministic: bool):
         x = TorchDense(self.d_model, dtype=self.dtype, name="fc1")(graph_em)
-        x = jnp.einsum("bij,bjd->bid", adj.astype(self.dtype), x)
+        if callable(adj):  # COO message-passing path (model.coo_matvec)
+            x = adj(x)
+        else:
+            x = jnp.einsum("bij,bjd->bid", adj.astype(self.dtype), x)
         x = TorchDense(self.d_model, dtype=self.dtype, name="fc2")(x)
         x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
         return nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype), name="norm")(x + graph_em)
