@@ -65,6 +65,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["dense", "segment"],
                    help="GCN message passing: dense bmm (default) or "
                         "O(edges) COO segment-sum for larger graphs")
+    p.add_argument("--copy-head", default=None, choices=["xla", "pallas"],
+                   help="pointer-score impl: XLA (materialized intermediate) "
+                        "or the fused Pallas kernel")
     p.add_argument("--profile-dir", default=None,
                    help="train: write a jax.profiler trace of a steady-state "
                         "step window here (TensorBoard-loadable)")
@@ -87,6 +90,8 @@ def _resolve_cfg(args):
         overrides["beam_compat_prob_space"] = False
     if args.adjacency:
         overrides["adjacency_impl"] = args.adjacency
+    if args.copy_head:
+        overrides["copy_head_impl"] = args.copy_head
     return cfg.replace(**overrides) if overrides else cfg
 
 

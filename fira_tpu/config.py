@@ -60,6 +60,10 @@ class FiraConfig:
     # "segment": gather/scatter message passing directly on the COO triplets —
     #   O(edges) memory, the path that scales past the 650-node geometry.
     adjacency_impl: str = "dense"
+    # "xla": pointer scores materialize the (B,T,S,D) tanh intermediate;
+    # "pallas": fused kernel streams it through VMEM (ops/copy_score.py) —
+    #   same math, no HBM intermediate (runs interpreted off-TPU).
+    copy_head_impl: str = "xla"
 
     # --- precision ---
     # Compute dtype for matmuls/attention. Params and the fused output

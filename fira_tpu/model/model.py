@@ -33,8 +33,11 @@ from fira_tpu.model.layers import (
     GCN,
     TorchDense,
     position_encoding,
+    torch_bias_init,
     torch_embed_init,
+    torch_kernel_init,
 )
+from fira_tpu.ops import copy_score
 
 
 def dense_adjacency(senders, receivers, values, graph_len: int) -> jnp.ndarray:
@@ -167,11 +170,36 @@ class Decoder(nn.Module):
         return x
 
 
+class _ScoreHead(nn.Module):
+    """Parameter container matching TorchDense(1, name="score") exactly
+    (names, shapes, init), so both score implementations share one
+    checkpoint-compatible param tree."""
+
+    d_in: int
+
+    @nn.compact
+    def __call__(self):
+        kernel = self.param("kernel", torch_kernel_init, (self.d_in, 1),
+                            jnp.float32)
+        bias = self.param(
+            "bias",
+            lambda k, s, d: torch_bias_init(k, s, d, self.d_in),
+            (1,), jnp.float32,
+        )
+        return kernel, bias
+
+
 class CopyNet(nn.Module):
     """Model.py:7-20: Bahdanau-style pointer scores over source positions
-    plus a 2-way generate/copy gate."""
+    plus a 2-way generate/copy gate.
+
+    ``impl`` selects the scoring path: "xla" materializes the (B,T,S,D)
+    tanh intermediate in forward and rematerializes it in backward
+    (jax.checkpoint); "pallas" runs the fused kernel (ops/copy_score.py)
+    that streams it through VMEM and never touches HBM with it."""
 
     d_model: int
+    impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -180,10 +208,20 @@ class CopyNet(nn.Module):
                          name="src_proj")(source)     # (B,S,D)
         tgt = TorchDense(self.d_model, use_bias=False, dtype=self.dtype,
                          name="tgt_proj")(target)     # (B,T,D)
-        # (B,T,S,D) additive interaction; the big intermediate is recomputed
-        # in the backward pass instead of stored (jax.checkpoint at call site).
-        inter = jnp.tanh(src[:, None, :, :] + tgt[:, :, None, :])
-        scores = TorchDense(1, dtype=self.dtype, name="score")(inter)[..., 0]
+        kernel, bias = _ScoreHead(self.d_model, name="score")()
+        if self.impl == "pallas":
+            scores = copy_score.copy_scores(
+                src, tgt, kernel.astype(self.dtype), bias.astype(self.dtype)
+            )
+        elif self.impl == "xla":
+            # remat: recompute the (B,T,S,D) tanh intermediate in backward
+            # instead of storing it (7.7 GB at the flagship geometry)
+            scores = jax.checkpoint(copy_score.copy_scores_reference)(
+                src, tgt, kernel.astype(self.dtype), bias.astype(self.dtype)
+            )
+        else:
+            raise ValueError(
+                f"copy_head_impl={self.impl!r} not in {{'xla', 'pallas'}}")
         gate = jax.nn.softmax(
             TorchDense(2, dtype=self.dtype, name="gate")(target).astype(
                 stable_dtype(self.dtype)
@@ -203,7 +241,8 @@ class FiraModel(nn.Module):
         cfg = self.cfg
         self.encoder = Encoder(cfg, dtype=self.dtype)
         self.decoder = Decoder(cfg, dtype=self.dtype)
-        self.copy_net = CopyNet(cfg.embedding_dim, dtype=self.dtype)
+        self.copy_net = CopyNet(cfg.embedding_dim, impl=cfg.copy_head_impl,
+                                dtype=self.dtype)
         self.out_fc = TorchDense(cfg.vocab_size, dtype=self.dtype)
 
     def encode(self, batch: Dict[str, jnp.ndarray], *,

@@ -33,17 +33,22 @@ MODEL_AXIS = "model"
 
 
 def make_mesh(n_data: Optional[int] = None, n_model: int = 1,
-              devices: Optional[Sequence] = None) -> Mesh:
-    """Build a (data, model) mesh. Defaults to all visible devices on the
-    data axis — the reference's DP regime. ``n_model > 1`` turns on tensor
-    parallelism for fira-large-scale runs."""
+              devices: Optional[Sequence] = None,
+              axis_names: Tuple[str, str] = (DATA_AXIS, MODEL_AXIS)) -> Mesh:
+    """Build a 2-axis mesh, (data, model) by default — the reference's DP
+    regime with all devices on the data axis. ``n_model > 1`` turns on
+    tensor parallelism for fira-large-scale runs; other second axes (e.g.
+    ring.SEQ_AXIS) reuse the same grid construction via ``axis_names``."""
     devices = list(devices if devices is not None else jax.devices())
     if n_data is None:
         if len(devices) % n_model:
             raise ValueError(f"{len(devices)} devices not divisible by n_model={n_model}")
         n_data = len(devices) // n_model
+    if len(devices) < n_data * n_model:
+        raise ValueError(
+            f"need {n_data * n_model} devices, have {len(devices)}")
     grid = np.asarray(devices[: n_data * n_model]).reshape(n_data, n_model)
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+    return Mesh(grid, axis_names)
 
 
 # (regex over the "/"-joined param path) -> PartitionSpec. First match wins;

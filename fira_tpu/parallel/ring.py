@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 SEQ_AXIS = "seq"
 NEG_INF = -1e9
@@ -38,12 +38,10 @@ NEG_INF = -1e9
 def seq_mesh(n_data: int, n_seq: int,
              devices: Optional[Sequence] = None) -> Mesh:
     """A (data, seq) mesh for sequence-parallel attention."""
-    devices = list(devices if devices is not None else jax.devices())
-    if len(devices) < n_data * n_seq:
-        raise ValueError(
-            f"need {n_data * n_seq} devices, have {len(devices)}")
-    grid = np.asarray(devices[: n_data * n_seq]).reshape(n_data, n_seq)
-    return Mesh(grid, ("data", SEQ_AXIS))
+    from fira_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_data=n_data, n_model=n_seq, devices=devices,
+                     axis_names=("data", SEQ_AXIS))
 
 
 def _block(q, k, v, kv_mask, bias):
@@ -90,36 +88,39 @@ def ring_attention(q, k, v, kv_mask, *, axis_name: str = SEQ_AXIS,
         allowed = k_pos[None, :] <= q_pos[:, None]            # (Tq, Tk)
         return jnp.where(allowed, 0.0, NEG_INF)[None, None, :, :]
 
-    def step(i, carry):
-        m_run, l_run, o_run, k_i, v_i, mask_i = carry
-        src_idx = (my_idx + i) % n_shards  # whose block we currently hold
+    def merge(carry, k_i, v_i, mask_i, src_idx):
+        m_run, l_run, o_run = carry
         bias = causal_bias(src_idx) if causal else None
         m_blk, l_blk, o_blk = _block(q, k_i, v_i, mask_i, bias)
-
         m_new = jnp.maximum(m_run, m_blk)
         alpha = jnp.exp(m_run - m_new)                        # rescale old
         beta = jnp.exp(m_blk - m_new)                         # rescale new
         l_new = l_run * alpha + l_blk * beta
         o_new = o_run * alpha[..., None] + o_blk * beta[..., None]
+        return m_new, l_new, o_new
 
-        # rotate K/V/mask one hop around the ring (next shard's block)
+    def step(i, carry):
+        acc, k_i, v_i, mask_i = carry
+        # rotate FIRST (the local block was consumed before the loop), so
+        # the final iteration's rotation isn't dead work: n_shards-1
+        # permutes total, like standard ring-attention schedules
         perm = [(j, (j - 1) % n_shards) for j in range(n_shards)]
         k_i = jax.lax.ppermute(k_i, axis_name, perm)
         v_i = jax.lax.ppermute(v_i, axis_name, perm)
         mask_i = jax.lax.ppermute(mask_i, axis_name, perm)
-        return m_new, l_new, o_new, k_i, v_i, mask_i
+        acc = merge(acc, k_i, v_i, mask_i, (my_idx + i) % n_shards)
+        return acc, k_i, v_i, mask_i
 
     # Initial running max NEG_INF (matches dense masking floor); one block is
-    # always processed, so l >= Tk * exp(-0) ... > 0 even fully masked,
-    # exactly like the dense softmax over all -1e9 rows.
+    # always processed, so l > 0 even fully masked, exactly like the dense
+    # softmax over all -1e9 rows.
     m0 = jnp.full((B, H, Tq), NEG_INF, dtype=jnp.float32)
     l0 = jnp.zeros((B, H, Tq), dtype=jnp.float32)
     o0 = jnp.zeros((B, H, Tq, Dh), dtype=jnp.float32)
 
-    carry = (m0, l0, o0, k, v, kv_mask)
-    # n_shards is a static python int under shard_map tracing via psum of 1?
-    # psum(1) of a static is concrete; fall back to fori_loop on the value.
-    m_f, l_f, o_f, *_ = jax.lax.fori_loop(0, n_shards, step, carry)
+    acc = merge((m0, l0, o0), k, v, kv_mask, my_idx)  # local block, no comm
+    (m_f, l_f, o_f), *_ = jax.lax.fori_loop(1, n_shards, step,
+                                            (acc, k, v, kv_mask))
     out = o_f / l_f[..., None]
     return out.astype(q.dtype)
 
