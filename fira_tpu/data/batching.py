@@ -38,8 +38,10 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
             src = np.concatenate([src, pad])
         batch[f] = src
 
-    senders = np.zeros((bs, cfg.max_edges), dtype=np.int32)
-    receivers = np.zeros((bs, cfg.max_edges), dtype=np.int32)
+    # int16 indices: graph_len caps at 650 << 32767, and edge arrays dominate
+    # the per-step host->device transfer (the model upcasts on device)
+    senders = np.zeros((bs, cfg.max_edges), dtype=np.int16)
+    receivers = np.zeros((bs, cfg.max_edges), dtype=np.int16)
     values = np.zeros((bs, cfg.max_edges), dtype=np.float32)
     offsets = split.arrays["edge_offsets"]
     for row, i in enumerate(indices):

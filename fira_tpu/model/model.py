@@ -50,7 +50,9 @@ def dense_adjacency(senders, receivers, values, graph_len: int) -> jnp.ndarray:
     B, _ = senders.shape
     adj = jnp.zeros((B, graph_len, graph_len), dtype=values.dtype)
     b_idx = jnp.arange(B)[:, None]
-    return adj.at[b_idx, senders, receivers].add(values)
+    # indices travel int16 to halve H2D traffic; scatter wants int32
+    return adj.at[b_idx, senders.astype(jnp.int32),
+                  receivers.astype(jnp.int32)].add(values)
 
 
 def coo_matvec(senders, receivers, values, x) -> jnp.ndarray:
@@ -62,6 +64,8 @@ def coo_matvec(senders, receivers, values, x) -> jnp.ndarray:
     """
     B = senders.shape[0]
     b_idx = jnp.arange(B)[:, None]
+    senders = senders.astype(jnp.int32)    # indices travel int16 (H2D size)
+    receivers = receivers.astype(jnp.int32)
     # accumulate in f32 like the dense einsum does on the MXU: bf16 scatter
     # sums over high-in-degree nodes would otherwise drift from the dense path
     acc_dtype = stable_dtype(x.dtype)

@@ -8,9 +8,11 @@ rematerialization and small batches. The Pallas kernel streams S in chunks
 through VMEM and never writes the intermediate to HBM: forward emits only
 the (B, T, S) scores; the custom-VJP backward recomputes tanh chunkwise and
 emits exactly the gradients (dsrc, dtgt, dw, dbias). Peak memory is
-O(B.S.D); wall-clock matches XLA's fused path (the op is tanh-VPU-bound:
-measured 8.1 vs 8.4 ms fwd at B=64 on v5e) — the win is memory headroom,
-i.e. batch size.
+O(B.S.D) — the win is memory headroom, i.e. batch size. Wall-clock is at
+parity in f32 (the op is tanh-VPU-bound: 8.1 vs 8.4 ms fwd at B=64 on v5e)
+and ~8% behind XLA in bf16 training (the kernel pins tanh to f32 for
+precision; XLA's fused path runs it in bf16) — so "xla" stays the default
+and "pallas" is the choice when the intermediate doesn't fit.
 
 Off-TPU the same kernels run under the Pallas interpreter, so CPU tests
 validate the math; ``copy_scores_reference`` is the XLA oracle both paths
@@ -47,15 +49,16 @@ def _pad_to(x, axis: int, mult: int):
 
 
 def _fwd_kernel(src_ref, tgt_ref, w_ref, out_ref):
-    tgt = tgt_ref[0]                                     # (Tp, D)
+    # tanh + matvec run in f32 whatever the input dtype: Mosaic rejects
+    # HIGHEST-precision matmuls on bf16 operands, and f32 keeps parity with
+    # XLA's fused path; the op is VPU-tanh-bound so this costs nothing.
+    tgt = tgt_ref[0].astype(jnp.float32)                 # (Tp, D)
     Tp, D = tgt.shape
     n_chunks = src_ref.shape[1] // _CHUNK
 
     def body(j, _):
-        s = src_ref[0, pl.ds(j * _CHUNK, _CHUNK), :]     # (C, D)
+        s = src_ref[0, pl.ds(j * _CHUNK, _CHUNK), :].astype(jnp.float32)
         x = jnp.tanh(s[None, :, :] + tgt[:, None, :])    # (Tp, C, D)
-        # HIGHEST: full-f32 MXU passes — the matvec is tiny and the op is
-        # bandwidth-bound, so this costs nothing and keeps parity with XLA
         sc = jnp.dot(x.reshape(-1, D), w_ref[:, :],
                      preferred_element_type=jnp.float32,
                      precision=jax.lax.Precision.HIGHEST)  # (Tp*C, 1)
@@ -124,7 +127,7 @@ def _copy_scores_fwd_impl(src, tgt, w, bias, interpret):
         ],
         out_specs=pl.BlockSpec((1, Tp, Sp), lambda b: (b, 0, 0)),
         interpret=_use_interpret(interpret),
-    )(src_p, tgt_p, w.astype(src.dtype))
+    )(src_p, tgt_p, w.astype(jnp.float32))
     return out[:, :T, :S] + bias[0].astype(src.dtype)
 
 
@@ -162,7 +165,7 @@ def _copy_scores_bwd(interpret, residuals, dout):
             pl.BlockSpec((1, D, 1), lambda b: (b, 0, 0)),
         ],
         interpret=_use_interpret(interpret),
-    )(src_p, tgt_p, w.astype(src.dtype), dout_p)
+    )(src_p, tgt_p, w.astype(jnp.float32), dout_p)
 
     dsrc = dsrc_p[:, :S, :]
     dtgt = dtgt_p[:, :T, :]
