@@ -1,0 +1,78 @@
+"""Force-JAX-onto-CPU guard, shared by tests/conftest.py and the driver
+contract (`__graft_entry__.dryrun_multichip`).
+
+The sandbox registers a TPU-tunnel PJRT plugin ("axon") in every interpreter
+via sitecustomize and pins JAX_PLATFORMS=axon. jax's first backends() call
+then eagerly dials the tunnel even for CPU-only work — and hangs indefinitely
+when the tunnel is down or busy. Multi-chip correctness checks run on virtual
+CPU devices (XLA_FLAGS=--xla_force_host_platform_device_count=N), so any code
+path that must work without the tunnel calls :func:`force_cpu_backend` BEFORE
+its first jax API call.
+
+Round-1 post-mortem: tests/conftest.py carried this guard but the driver's
+`dryrun_multichip` did not, and the official multi-chip artifact timed out
+(VERDICT.md "What's weak" #1). The guard now lives here so both entry points
+share one implementation.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_DEVICE_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def _disabled_factory(*_a, **_k):
+    raise RuntimeError(
+        "non-cpu backend disabled by fira_tpu.utils.backend_guard")
+
+
+def force_cpu_backend(n_virtual_devices: int | None = None) -> None:
+    """Pin this interpreter to the CPU backend, immune to the TPU tunnel.
+
+    Idempotent; safe to call multiple times. Must run before jax creates its
+    first backend (calling it later still flips jax_platforms but cannot
+    un-dial an already-initialized non-CPU backend — callers that may run
+    after arbitrary jax use should prefer a fresh process).
+
+    Args:
+      n_virtual_devices: if given, ensure XLA_FLAGS requests at least this
+        many virtual CPU host devices (no-op if the flag is already present —
+        the driver sets it itself).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_virtual_devices is not None:
+        xf = os.environ.get("XLA_FLAGS", "")
+        m = re.search(_DEVICE_COUNT_FLAG + r"=(\d+)", xf)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (
+                xf + f" {_DEVICE_COUNT_FLAG}={n_virtual_devices}").strip()
+        elif int(m.group(1)) < n_virtual_devices:
+            # A smaller preexisting count (e.g. leftover from a smaller run)
+            # would make jax.devices("cpu") come up short; raise it.
+            os.environ["XLA_FLAGS"] = (
+                xf[:m.start()]
+                + f"{_DEVICE_COUNT_FLAG}={n_virtual_devices}"
+                + xf[m.end():])
+
+    try:
+        from jax._src import xla_bridge as xb
+
+        for name in list(getattr(xb, "_backend_factories", {})):
+            if name != "cpu":
+                # Keep the name registered (mlir.register_lowering validates
+                # platform names against this table — chex/checkify registers
+                # tpu lowerings at import) but make the factory inert so
+                # nothing ever dials the tunnel.
+                import dataclasses as _dc
+
+                entry = xb._backend_factories[name]
+                if entry.factory is not _disabled_factory:
+                    xb._backend_factories[name] = _dc.replace(
+                        entry, factory=_disabled_factory)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # older/newer jax layouts: fall back to the env vars alone

@@ -13,45 +13,15 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the harness env pins 'axon'
-_xf = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _xf:
-    os.environ["XLA_FLAGS"] = (_xf + " --xla_force_host_platform_device_count=8").strip()
+# Make tests immune to the TPU tunnel ('axon' PJRT plugin): the sandbox
+# registers the plugin in every interpreter via sitecustomize and pins
+# JAX_PLATFORMS=axon; jax.backends() then eagerly dials the tunnel even for
+# CPU work, and hangs indefinitely when the tunnel is down. The shared guard
+# disables non-CPU backend factories before the first backends() call so the
+# whole test session stays on the virtual 8-device CPU mesh.
+from fira_tpu.utils.backend_guard import force_cpu_backend  # noqa: E402
 
-
-def _force_cpu_backend():
-    """Make tests immune to the TPU tunnel ('axon' PJRT plugin).
-
-    The sandbox registers the axon plugin in every interpreter via
-    sitecustomize and pins JAX_PLATFORMS=axon; jax.backends() then eagerly
-    dials the tunnel even for CPU work, and hangs indefinitely when the
-    tunnel is down. Deregistering the factory before the first backends()
-    call keeps the whole test session on the virtual 8-device CPU mesh.
-    """
-    try:
-        from jax._src import xla_bridge as xb
-
-        def _disabled(*_a, **_k):
-            raise RuntimeError("non-cpu backend disabled by tests/conftest.py")
-
-        for name in list(getattr(xb, "_backend_factories", {})):
-            if name != "cpu":
-                # Keep the name registered (mlir.register_lowering validates
-                # platform names against this table — chex/checkify registers
-                # tpu lowerings at import) but make the factory inert so
-                # nothing ever dials the tunnel.
-                import dataclasses as _dc
-
-                entry = xb._backend_factories[name]
-                xb._backend_factories[name] = _dc.replace(entry, factory=_disabled)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass  # older/newer jax layouts: fall back to env vars alone
-
-
-_force_cpu_backend()
+force_cpu_backend(n_virtual_devices=8)
 
 REFERENCE_ROOT = "/root/reference"
 
