@@ -1,13 +1,28 @@
 """Benchmark: training throughput in commits/sec/chip (the repo's metric of
 record, BASELINE.md) on the flagship fira-full geometry.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line to stdout in every outcome:
+  success -> {"metric", "value", "unit", "vs_baseline", "mfu", ...}
+  failure -> {"metric", "value": null, "unit", "vs_baseline": null, "error", ...}
+
+The TPU tunnel this runs through is flaky and can HANG (not just fail) during
+backend init, so the harness is split into three roles:
+
+  orchestrator (default)  retries a bounded-timeout PROBE subprocess until the
+                          backend answers, then runs the WORKER subprocess
+                          (also bounded); a hung backend is killed, backed off,
+                          and retried.  On final failure it still emits the
+                          one structured JSON line.
+  --probe                 imports jax, forces device init (jax.devices()),
+                          prints the platform/device_kind, exits.
+  --worker                the actual measurement (below).
 
 What is measured: end-to-end jitted train steps (forward + loss + backward +
 Adam) at the reference's exact model geometry — d=256, 6 GCN rounds over
 650-node graphs, 6 decoder layers, dual copy head, 24,650-word fused output
-(Model.py:81) — per-chip batch 170 (run_model.py:40), INCLUDING host->device
-batch transfer (numpy batches are fed each step, COO edges not dense 650²).
+(/root/reference/Model.py:81) — per-chip batch 170 (run_model.py:40),
+INCLUDING host->device batch transfer (numpy batches are fed each step, COO
+edges not dense 650²).
 
 vs_baseline: the reference publishes no throughput numbers (SURVEY.md §6).
 The denominator is an estimate of the reference stack's training rate on its
@@ -18,24 +33,123 @@ PCIe (~95 ms floor at 12 GB/s) plus the DataParallel scatter/gather and the
 gives 680/0.5/4 = 340 commits/sec/chip. We use 340 — the optimistic end, so
 vs_baseline understates rather than oversells the speedup.
 
+mfu: model FLOPs/step (XLA's own compiled cost analysis of the train step;
+analytic fallback if unavailable) / measured step time / chip peak FLOPs for
+the benchmark dtype.  Peak is looked up from device_kind (override with
+FIRA_TPU_PEAK_FLOPS); flops_per_step and peak_flops are reported alongside so
+the number is auditable.
+
 Env knobs: FIRA_BENCH_DTYPE=float32|bfloat16 (default bfloat16, the TPU fast
 path; quality parity is validated in f32 by the test suite),
-FIRA_BENCH_STEPS, FIRA_BENCH_BATCH.
+FIRA_BENCH_STEPS, FIRA_BENCH_BATCH, FIRA_BENCH_WINDOWS,
+FIRA_BENCH_PROBE_TIMEOUT (s, default 90), FIRA_BENCH_WORKER_TIMEOUT (s,
+default 1500), FIRA_BENCH_ALLOW_CPU=1 (let the worker run on CPU — for
+harness testing only; the result is flagged "platform": "cpu").
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 EST_BASELINE_COMMITS_PER_SEC_PER_CHIP = 340.0
+METRIC = "train_commits_per_sec_per_chip"
+UNIT = "commits/sec/chip"
+
+# bf16 peak FLOPs/s per chip by device_kind (public spec sheets); fp32 peaks
+# are ~= bf16/2 on v4+ (no separate fp32 MXU path — XLA upcasts around the
+# same systolic array), so we halve for float32 runs.
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU v7": 2307e12,
+}
 
 
-def main() -> None:
+def _peak_flops(device_kind: str, dtype: str) -> float | None:
+    env = os.environ.get("FIRA_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    for k, v in PEAK_BF16_FLOPS.items():
+        if device_kind.lower().startswith(k.lower()):
+            return v / (2.0 if dtype == "float32" else 1.0)
+    return None
+
+
+# --------------------------------------------------------------------------
+# probe: force backend init, report what answered
+# --------------------------------------------------------------------------
+
+def _maybe_force_cpu() -> None:
+    # Harness-test mode: the sandbox's sitecustomize pins JAX_PLATFORMS=axon
+    # in every interpreter, so a plain env var cannot keep jax off the
+    # tunnel — the shared guard disables the non-CPU backend factories.
+    if os.environ.get("FIRA_BENCH_ALLOW_CPU") == "1":
+        from fira_tpu.utils.backend_guard import force_cpu_backend
+
+        force_cpu_backend()
+
+
+def probe() -> None:
+    _maybe_force_cpu()
+    import jax
+
+    devs = jax.devices()  # raises / hangs here if the tunnel is sick
+    print(json.dumps({
+        "platform": devs[0].platform,
+        "device_kind": devs[0].device_kind,
+        "n_devices": len(devs),
+    }))
+
+
+# --------------------------------------------------------------------------
+# worker: the measurement itself
+# --------------------------------------------------------------------------
+
+def _flops_per_step(compiled) -> tuple[float | None, str]:
+    """XLA's compiled cost analysis; (flops, source) or (None, reason)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) if ca else 0.0
+        if flops > 0:
+            return flops, "xla_cost_analysis"
+        return None, "cost_analysis_empty"
+    except Exception as e:  # pragma: no cover - backend-specific
+        return None, f"cost_analysis_failed: {type(e).__name__}"
+
+
+def _analytic_flops(cfg, batch_size: int) -> float:
+    """Fallback matmul-FLOPs estimate for one fwd+bwd+opt step (bwd ~= 2x
+    fwd).  Counts only the MXU terms (dense projections + attention + fused
+    output head); elementwise and normalization terms are noise next to them.
+    """
+    d = cfg.embedding_dim
+    g, s, t, v = (cfg.graph_len, cfg.sou_len + cfg.sub_token_len, cfg.tar_len,
+                  cfg.output_vocab_size)
+    enc = cfg.num_layers * (2 * g * d * d * 2 + g * g * d * 2)   # fc1/fc2 + A.x
+    dec = cfg.num_layers * (
+        8 * t * d * d * 2          # self+cross qkvo projections
+        + 2 * (t * t + t * s) * d * 2   # score + mix matmuls
+        + 2 * t * d * 4 * d * 2    # FFN in/out
+    )
+    head = t * d * v * 2 + t * s * d * 2 * 3   # fused out_fc + copy scorer
+    return 3.0 * batch_size * (enc + dec + head)
+
+
+def worker() -> None:
+    _maybe_force_cpu()
     import jax
     import numpy as np
 
@@ -45,6 +159,20 @@ def main() -> None:
     from fira_tpu.model.model import FiraModel
     from fira_tpu.train import step as step_lib
     from fira_tpu.train.state import init_state
+
+    # Trigger device init FIRST (verdict r2 item 1): fail fast, before any
+    # batch building, and record what we're running on.
+    devs = jax.devices()
+    platform = devs[0].platform
+    device_kind = devs[0].device_kind
+    if platform != "tpu" and os.environ.get("FIRA_BENCH_ALLOW_CPU") != "1":
+        print(json.dumps({
+            "metric": METRIC, "value": None, "unit": UNIT,
+            "vs_baseline": None,
+            "error": f"no TPU backend (got platform={platform!r}); "
+                     "set FIRA_BENCH_ALLOW_CPU=1 to bench anyway",
+        }))
+        sys.exit(1)
 
     dtype = os.environ.get("FIRA_BENCH_DTYPE", "bfloat16")
     n_steps = int(os.environ.get("FIRA_BENCH_STEPS", "20"))
@@ -68,10 +196,20 @@ def main() -> None:
 
     model = FiraModel(cfg, dtype=jnp.dtype(dtype))
     state = init_state(model, cfg, host_batches[0])
+    # AOT-compile once and reuse the executable for the timed loop: going
+    # through jit dispatch after lower().compile() would trace+compile the
+    # whole program a second time (the AOT result does not populate the jit
+    # cache), doubling startup inside the worker timeout.
     train_step = jax.jit(step_lib.make_train_step(model, cfg),
-                         donate_argnums=(0,))
+                         donate_argnums=(0,)
+                         ).lower(state, host_batches[0]).compile()
 
-    # warmup / compile
+    flops, flops_source = _flops_per_step(train_step)
+    if flops is None:
+        flops = _analytic_flops(cfg, batch_size)
+        flops_source = f"analytic ({flops_source})"
+
+    # warmup (transfers + executable load)
     state, metrics = train_step(state, host_batches[0])
     jax.block_until_ready(metrics["loss"])
 
@@ -80,7 +218,7 @@ def main() -> None:
     # steady state through the tunnel), and tunnel stalls can triple a
     # window; the median of the remaining windows is the reproducible
     # steady-state number.
-    n_windows = int(os.environ.get("FIRA_BENCH_WINDOWS", "5"))
+    n_windows = max(1, int(os.environ.get("FIRA_BENCH_WINDOWS", "5")))
     times = []
     for _ in range(n_windows + 1):
         t0 = time.perf_counter()
@@ -95,14 +233,131 @@ def main() -> None:
     # the step above is jitted without a mesh: it runs on exactly one chip
     # regardless of how many are visible
     n_chips = 1
-    value = n_steps * batch_size / dt / n_chips
+    step_time = dt / n_steps
+    value = batch_size / step_time / n_chips
+
+    peak = _peak_flops(device_kind, dtype)
+    mfu = round(flops / step_time / peak, 4) if peak else None
+
     print(json.dumps({
-        "metric": "train_commits_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(value, 2),
-        "unit": "commits/sec/chip",
+        "unit": UNIT,
         "vs_baseline": round(value / EST_BASELINE_COMMITS_PER_SEC_PER_CHIP, 3),
+        "mfu": mfu,
+        "flops_per_step": flops,
+        "flops_source": flops_source,
+        "step_time_s": round(step_time, 5),
+        "peak_flops": peak,
+        "platform": platform,
+        "device_kind": device_kind,
+        "dtype": dtype,
+        "batch_size": batch_size,
     }))
 
 
+# --------------------------------------------------------------------------
+# orchestrator: bounded retries around probe + worker
+# --------------------------------------------------------------------------
+
+def _run_sub(mode: str, timeout_s: float) -> tuple[int | None, str, str]:
+    """Run `python bench.py --<mode>`; rc None means timed out (killed)."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), f"--{mode}"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        return None, out, err
+
+
+def _last_json_line(out: str) -> dict | None:
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def orchestrate() -> None:
+    probe_timeout = float(os.environ.get("FIRA_BENCH_PROBE_TIMEOUT", "90"))
+    worker_timeout = float(os.environ.get("FIRA_BENCH_WORKER_TIMEOUT", "1500"))
+    backoffs = [5, 10, 20, 40]  # 5 probe attempts over ~3 min of sleep
+    attempts: list[dict] = []
+
+    def fail(error: str) -> None:
+        print(json.dumps({
+            "metric": METRIC, "value": None, "unit": UNIT,
+            "vs_baseline": None, "mfu": None,
+            "error": error, "attempts": attempts,
+        }))
+        sys.exit(1)
+
+    # Phase 1: probe until the backend answers (a hung init is killed).
+    probed = None
+    for i in range(len(backoffs) + 1):
+        t0 = time.time()
+        rc, out, err = _run_sub("probe", probe_timeout)
+        rec = {"phase": "probe", "rc": rc, "secs": round(time.time() - t0, 1)}
+        if rc == 0 and (probed := _last_json_line(out)):
+            rec["result"] = probed
+            attempts.append(rec)
+            break
+        rec["tail"] = (err or out).strip()[-300:]
+        attempts.append(rec)
+        print(f"probe attempt {i + 1} failed "
+              f"({'timeout' if rc is None else f'rc={rc}'})", file=sys.stderr)
+        if i < len(backoffs):
+            time.sleep(backoffs[i])
+    else:
+        fail(f"backend init failed/hung on all {len(backoffs) + 1} probe "
+             f"attempts ({probe_timeout:.0f}s timeout each)")
+
+    if probed.get("platform") != "tpu" \
+            and os.environ.get("FIRA_BENCH_ALLOW_CPU") != "1":
+        fail(f"backend answered but is not TPU: {probed}")
+
+    # Phase 2: the measurement, retried once (compile caching makes the
+    # second attempt cheaper if the first died mid-run).
+    worker_error = None
+    for i in range(2):
+        t0 = time.time()
+        rc, out, err = _run_sub("worker", worker_timeout)
+        rec = {"phase": "worker", "rc": rc, "secs": round(time.time() - t0, 1)}
+        result = _last_json_line(out)
+        if rc == 0 and result and result.get("value") is not None:
+            print(json.dumps(result))
+            return
+        if result and result.get("error"):
+            # the worker's own structured error is the real cause — keep it
+            worker_error = result["error"]
+            rec["error"] = worker_error
+        else:
+            # latest attempt's cause wins (a stale attempt-1 error must not
+            # masquerade as the reason attempt 2 timed out)
+            worker_error = ("worker timed out" if rc is None
+                            else f"worker failed (rc={rc})")
+            rec["tail"] = (err or out).strip()[-500:]
+        attempts.append(rec)
+        print(f"worker attempt {i + 1} failed "
+              f"({'timeout' if rc is None else f'rc={rc}'})", file=sys.stderr)
+        if worker_error and "no TPU backend" in worker_error:
+            break  # deterministic — the platform will not change on retry
+        if i == 0:
+            time.sleep(10)
+    fail(worker_error or "worker failed on both attempts")
+
+
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        probe()
+    elif "--worker" in sys.argv:
+        worker()
+    else:
+        orchestrate()

@@ -39,7 +39,13 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
         batch[f] = src
 
     # int16 indices: graph_len caps at 650 << 32767, and edge arrays dominate
-    # the per-step host->device transfer (the model upcasts on device)
+    # the per-step host->device transfer (the model upcasts on device).
+    # Enforce the dtype's precondition: a config scaled past int16 range
+    # must fail loudly here, not wrap around silently in the scatter.
+    if cfg.graph_len - 1 > np.iinfo(np.int16).max:  # indices are 0..len-1
+        raise ValueError(
+            f"graph_len={cfg.graph_len} exceeds int16 edge-index range "
+            f"(max index {np.iinfo(np.int16).max}); widen the edge dtype")
     senders = np.zeros((bs, cfg.max_edges), dtype=np.int16)
     receivers = np.zeros((bs, cfg.max_edges), dtype=np.int16)
     values = np.zeros((bs, cfg.max_edges), dtype=np.float32)

@@ -1196,6 +1196,16 @@ class Parser {
   Node* parse_postfix() {
     size_t s = mark();
     Node* e = parse_primary();
+    // Postfix chains (a.b().c()[i]...) deepen the tree ITERATIVELY, so the
+    // recursive DepthGuard never sees them — bound the wrapping links too, or
+    // a pathological chain re-creates the stack-overflow the guard exists to
+    // prevent (recursive finalize/serialize/destruct all walk this spine).
+    // Only node-WRAPPING branches call bump(): the QualifiedName merge folds
+    // arbitrarily many '.name's into one flat leaf and must stay unbounded.
+    int links = 0;
+    auto bump = [&] {
+      if (depth_ + ++links >= kMaxDepth) err("postfix chain too deep");
+    };
     while (true) {
       if (at_op(".")) {
         // method invocation / field access / qualified this / inner new /
@@ -1203,6 +1213,7 @@ class Parser {
         if (peek().kind == Tok::Ident) {
           bool call = peek(2).kind == Tok::Op && peek(2).text == "(";
           if (call) {
+            bump();
             advance();  // '.'
             Node* n = node("MethodInvocation");
             n->children.push_back(e);
@@ -1221,6 +1232,7 @@ class Parser {
             e->label += "." + name.text;
             e->length = name.pos + static_cast<int>(name.text.size()) - e->pos;
           } else {
+            bump();
             Node* n = node("FieldAccess");
             n->children.push_back(e);
             n->children.push_back(leaf("SimpleName", name));
@@ -1233,6 +1245,7 @@ class Parser {
           // expr.<T>m(...)
           State st = save();
           try {
+            bump();
             advance();  // '.'
             std::vector<Node*> targs;
             parse_type_args(targs);
@@ -1249,6 +1262,7 @@ class Parser {
           }
         }
         if (peek().kind == Tok::Keyword && peek().text == "this") {
+          bump();
           advance(); advance();
           Node* n = node("ThisExpression");  // qualified this; no label
           n->children.push_back(e);
@@ -1257,6 +1271,7 @@ class Parser {
           continue;
         }
         if (peek().kind == Tok::Keyword && peek().text == "new") {
+          bump();
           advance();
           Node* n = parse_new(s, e);
           e = n;
@@ -1264,6 +1279,7 @@ class Parser {
         }
         if (peek().kind == Tok::Keyword && peek().text == "class") {
           // Name.class
+          bump();
           advance(); advance();
           Node* tl = node("TypeLiteral");
           if ((e->typeLabel == "SimpleName" || e->typeLabel == "QualifiedName") &&
@@ -1280,6 +1296,7 @@ class Parser {
           continue;
         }
         if (peek().kind == Tok::Keyword && peek().text == "super") {
+          bump();
           // Outer.super.m(...) / Outer.super.x — keep the qualifier as the
           // first child (JDT shape) so its source token stays in the tree.
           advance(); advance();
@@ -1303,6 +1320,7 @@ class Parser {
         err("unsupported '.' suffix");
       }
       if (at_op("[")) {
+        bump();
         advance();
         Node* n = node("ArrayAccess");
         n->children.push_back(e);
@@ -1313,6 +1331,7 @@ class Parser {
         continue;
       }
       if (at_op("++") || at_op("--")) {
+        bump();
         std::string op = advance().text;
         Node* n = node("PostfixExpression");
         n->label = op; n->has_label = true;
@@ -1322,6 +1341,7 @@ class Parser {
         continue;
       }
       if (at_op("::")) {
+        bump();
         advance();
         Node* n = node("ExpressionMethodReference");
         n->children.push_back(e);

@@ -114,9 +114,13 @@ def _shard_dir(out_dir: str, begin: int, end: int) -> str:
 
 
 def _shard_done(out_dir: str, begin: int, end: int) -> bool:
+    # errors.json is part of done-ness: it is always written (possibly []),
+    # so a shard that crashed between its stream writes and its error record
+    # reprocesses instead of passing for a clean shard on re-run.
     d = _shard_dir(out_dir, begin, end)
     return all(os.path.exists(os.path.join(d, f"{s}.json"))
-               for s in GRAPH_STREAMS)
+               for s in GRAPH_STREAMS) \
+        and os.path.exists(os.path.join(d, "errors.json"))
 
 
 def _run_shard(job: Tuple[str, int, int, list, list]) -> Tuple[int, int, int]:
@@ -128,10 +132,8 @@ def _run_shard(job: Tuple[str, int, int, list, list]) -> Tuple[int, int, int]:
         # idempotent re-run: report the errors recorded when the shard ran,
         # so re-runs don't claim a clean corpus that isn't
         err_path = os.path.join(_shard_dir(out_dir, begin, end), "errors.json")
-        if os.path.exists(err_path):
-            with open(err_path) as f:
-                return begin, end, len(json.load(f))
-        return begin, end, 0
+        with open(err_path) as f:
+            return begin, end, len(json.load(f))
     streams, errors = process_commits(difftokens, diffmarks, 0,
                                       end - begin, index_offset=begin)
     d = _shard_dir(out_dir, begin, end)
@@ -141,9 +143,11 @@ def _run_shard(job: Tuple[str, int, int, list, list]) -> Tuple[int, int, int]:
         with open(tmp, "w") as f:
             json.dump(streams[s], f)
         os.replace(tmp, os.path.join(d, f"{s}.json"))
-    if errors:
-        with open(os.path.join(d, "errors.json"), "w") as f:
-            json.dump(errors, f, indent=1)
+    # last write completes the shard (atomic like the streams above)
+    tmp = os.path.join(d, "errors.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(errors, f, indent=1)
+    os.replace(tmp, os.path.join(d, "errors.json"))
     return begin, end, len(errors)
 
 

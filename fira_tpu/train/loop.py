@@ -214,8 +214,9 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
     if profiling_active:  # run ended inside the profile window
         jax.profiler.stop_trace()
         log.console(f"profile trace written to {profile_dir}")
-    elif profile_dir and global_step <= (profile_window[0] if profile_window
-                                         else 0):
+    elif profile_dir and not profile_window:
+        log.console("profile trace NOT written: profile_steps=0")
+    elif profile_dir and global_step <= profile_window[0]:
         log.console(f"profile trace NOT written: run ended after "
                     f"{global_step} steps, before the profile window "
                     f"(starts at step {profile_window[0]})")
