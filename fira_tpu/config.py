@@ -73,6 +73,7 @@ class FiraConfig:
     # --- decode ---
     beam_compat_prob_space: bool = True  # reference prob-space accumulation
                                          # (run_model.py:271,305); False => log-space
+    beam_kv_cache: bool = True  # O(T) cached decode vs full-prefix re-decode
 
     @property
     def graph_len(self) -> int:

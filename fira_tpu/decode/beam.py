@@ -58,26 +58,11 @@ def _resolve_copy(tok, diff, sub_token, cfg: FiraConfig):
     )
 
 
-def beam_search(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
-                cfg: FiraConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (tokens (B, beam, tar_len) with copy ids already resolved,
-    scores (B, beam)). The best beam is argmax(scores) (run_model.py:351).
-
-    Jit this via `make_beam_step` below or wrap in jax.jit at the call site;
-    everything inside is fixed-shape.
-    """
-    K, T, V_out = cfg.beam_size, cfg.tar_len, cfg.output_vocab_size
-    B = batch["diff"].shape[0]
-    prob_space = cfg.beam_compat_prob_space
-
-    states, mask = model.apply({"params": params}, batch,
-                               method=FiraModel.encode)
-    # fold beams into batch for the decoder: (B*K, ...)
-    states_k = jnp.repeat(states, K, axis=0)
-    mask_k = jnp.repeat(mask, K, axis=0)
-
+def _init_beam(B: int, cfg: FiraConfig):
+    """Initial (tokens, probs, finished) carry + the masked/pad value."""
+    K, T = cfg.beam_size, cfg.tar_len
     tokens0 = jnp.zeros((B, K, T), jnp.int32).at[:, :, 0].set(START_ID)
-    if prob_space:
+    if cfg.beam_compat_prob_space:
         # beam 0 prob 1, others 0 (run_model.py:216-221)
         probs0 = jnp.tile(jnp.asarray([1.0] + [0.0] * (K - 1), jnp.float32),
                           (B, 1))
@@ -88,6 +73,61 @@ def beam_search(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
         )
         neg = jnp.float32(-np.inf)
     finished0 = jnp.zeros((B, K), bool)
+    return tokens0, probs0, finished0, neg
+
+
+def _select(dist, tokens, probs, finished, s, batch, cfg: FiraConfig, neg):
+    """One beam-selection round given this step's fused distribution.
+
+    dist: (B, K, V_out) probability-space distribution at position ``s``.
+    Implements the reference's candidate construction exactly: active beams
+    contribute dist x prob (prob- or log-space), finished beams are masked
+    to ``neg`` and contribute a sentinel entry carrying their own
+    probability; one global top-k over K*V_out + K candidates
+    (run_model.py:267-310). Returns (new_tokens, new_probs, new_finished,
+    src_beam)."""
+    B, K, V_out = dist.shape
+    if cfg.beam_compat_prob_space:
+        cand = dist * probs[:, :, None]
+    else:
+        cand = jnp.log(jnp.clip(dist, 1e-10, 1.0)) + probs[:, :, None]
+    cand = jnp.where(finished[:, :, None], neg, cand)
+    sentinel = jnp.where(finished, probs, neg)          # (B, K)
+    allc = jnp.concatenate([cand.reshape(B, K * V_out), sentinel], axis=1)
+    top_vals, top_idx = jax.lax.top_k(allc, K)          # (B, K)
+
+    is_sent = top_idx >= K * V_out
+    src_beam = jnp.where(is_sent, top_idx - K * V_out, top_idx // V_out)
+    tok = jnp.where(is_sent, 0, top_idx % V_out)
+    tok = _resolve_copy(tok, batch["diff"], batch["sub_token"], cfg)
+
+    new_tokens = jnp.take_along_axis(tokens, src_beam[:, :, None], axis=1)
+    keep = new_tokens[:, :, s + 1]  # finished beams keep their padding
+    new_tokens = new_tokens.at[:, :, s + 1].set(
+        jnp.where(is_sent, keep, tok)
+    )
+    new_finished = jnp.where(is_sent, True, tok == EOS_ID)
+    return new_tokens, top_vals, new_finished, src_beam
+
+
+def beam_search(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
+                cfg: FiraConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (tokens (B, beam, tar_len) with copy ids already resolved,
+    scores (B, beam)). The best beam is argmax(scores) (run_model.py:351).
+
+    Jit this via `make_beam_step` below or wrap in jax.jit at the call site;
+    everything inside is fixed-shape.
+    """
+    K, T, V_out = cfg.beam_size, cfg.tar_len, cfg.output_vocab_size
+    B = batch["diff"].shape[0]
+
+    states, mask = model.apply({"params": params}, batch,
+                               method=FiraModel.encode)
+    # fold beams into batch for the decoder: (B*K, ...)
+    states_k = jnp.repeat(states, K, axis=0)
+    mask_k = jnp.repeat(mask, K, axis=0)
+
+    tokens0, probs0, finished0, neg = _init_beam(B, cfg)
 
     def step(carry, s):
         tokens, probs, finished = carry
@@ -102,30 +142,9 @@ def beam_search(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
             method=FiraModel.fused_probs,
         )  # (B*K, T, V_out)
         dist = fused[:, s, :].reshape(B, K, V_out)
-        if prob_space:
-            cand = dist * probs[:, :, None]
-        else:
-            cand = jnp.log(jnp.clip(dist, 1e-10, 1.0)) + probs[:, :, None]
-        cand = jnp.where(finished[:, :, None], neg, cand)
-        sentinel = jnp.where(finished, probs, neg)          # (B, K)
-        allc = jnp.concatenate([cand.reshape(B, K * V_out), sentinel], axis=1)
-        top_vals, top_idx = jax.lax.top_k(allc, K)          # (B, K)
-
-        is_sent = top_idx >= K * V_out
-        src_beam = jnp.where(is_sent, top_idx - K * V_out, top_idx // V_out)
-        tok = jnp.where(is_sent, 0, top_idx % V_out)
-        tok = _resolve_copy(tok, batch["diff"], batch["sub_token"], cfg)
-
-        gather = lambda arr: jnp.take_along_axis(
-            arr, src_beam.reshape(B, K, *([1] * (arr.ndim - 2))), axis=1
-        )
-        new_tokens = gather(tokens)
-        keep = new_tokens[:, :, s + 1]  # finished beams keep their padding
-        new_tokens = new_tokens.at[:, :, s + 1].set(
-            jnp.where(is_sent, keep, tok)
-        )
-        new_finished = jnp.where(is_sent, True, tok == EOS_ID)
-        return (new_tokens, top_vals, new_finished), None
+        new_tokens, new_probs, new_finished, _ = _select(
+            dist, tokens, probs, finished, s, batch, cfg, neg)
+        return (new_tokens, new_probs, new_finished), None
 
     (tokens, probs, _), _ = jax.lax.scan(
         step, (tokens0, probs0, finished0), jnp.arange(T - 1)
@@ -133,6 +152,74 @@ def beam_search(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
     return tokens, probs
 
 
+def beam_search_cached(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
+                       cfg: FiraConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """KV-cached beam search: identical selection semantics to
+    :func:`beam_search` (the equivalence is pinned by
+    tests/test_train_decode.py), but each scan step decodes ONE position via
+    per-layer self-attention caches, with cross-attention K/V and the copy
+    head's source projection computed once per batch — O(T) decoder work
+    overall instead of the reference's O(T^2) full re-decode per step
+    (run_model.py:256; SURVEY.md §7 build-plan 6).
+
+    The cache is beam-gathered with the same src_beam permutation as the
+    token prefixes each step, so reshuffled beams keep consistent histories.
+    """
+    K, T, V_out = cfg.beam_size, cfg.tar_len, cfg.output_vocab_size
+    B = batch["diff"].shape[0]
+    L, H = cfg.num_layers, cfg.num_head
+    d_head = cfg.embedding_dim // H
+
+    states, mask = model.apply({"params": params}, batch,
+                               method=FiraModel.encode)
+    states_k = jnp.repeat(states, K, axis=0)
+    mask_k = jnp.repeat(mask, K, axis=0)
+    # project once per ITEM, then replicate per beam — beams share encoder
+    # states, so projecting states_k would do K-fold duplicate matmuls
+    cross_k, cross_v, src_proj = model.apply(
+        {"params": params}, states, method=FiraModel.decode_init)
+    cross_k = jnp.repeat(cross_k, K, axis=1)   # (L, B*K, H, S, d_head)
+    cross_v = jnp.repeat(cross_v, K, axis=1)
+    src_proj = jnp.repeat(src_proj, K, axis=0)
+
+    tokens0, probs0, finished0, neg = _init_beam(B, cfg)
+    cache0 = jnp.zeros((L, B * K, H, T, d_head), states.dtype)
+
+    def step(carry, s):
+        tokens, probs, finished, k_cache, v_cache = carry
+        flat = tokens.reshape(B * K, T)
+        # same per-position validity rule as the full-prefix path's pad
+        # mask, restricted causally to positions <= s
+        valid = (flat != 0).at[:, 0].set(True) & (jnp.arange(T)[None, :] <= s)
+        tok_in = jax.lax.dynamic_slice_in_dim(flat, s, 1, axis=1)  # (B*K, 1)
+        fused, k_cache, v_cache = model.apply(
+            {"params": params}, states_k, mask_k, tok_in, s,
+            k_cache, v_cache, cross_k, cross_v, src_proj,
+            valid[:, None, None, :],
+            method=FiraModel.fused_probs_step,
+        )  # (B*K, 1, V_out)
+        dist = fused[:, 0, :].reshape(B, K, V_out)
+        new_tokens, new_probs, new_finished, src_beam = _select(
+            dist, tokens, probs, finished, s, batch, cfg, neg)
+        # permute cached histories to follow their beams: (L, B, K, ...)
+        idx = src_beam[None, :, :, None, None, None]
+
+        def gather_cache(c):
+            c = c.reshape(L, B, K, H, T, d_head)
+            c = jnp.take_along_axis(c, idx, axis=2)
+            return c.reshape(L, B * K, H, T, d_head)
+
+        return (new_tokens, new_probs, new_finished,
+                gather_cache(k_cache), gather_cache(v_cache)), None
+
+    (tokens, probs, *_), _ = jax.lax.scan(
+        step, (tokens0, probs0, finished0, cache0, cache0), jnp.arange(T - 1)
+    )
+    return tokens, probs
+
+
 def make_beam_search(model: FiraModel, cfg: FiraConfig):
-    """jit-compiled beam search closure over (params, batch)."""
-    return jax.jit(lambda params, batch: beam_search(model, params, batch, cfg))
+    """jit-compiled beam search closure over (params, batch); KV-cached by
+    default (cfg.beam_kv_cache), full-prefix re-decode otherwise."""
+    impl = beam_search_cached if cfg.beam_kv_cache else beam_search
+    return jax.jit(lambda params, batch: impl(model, params, batch, cfg))

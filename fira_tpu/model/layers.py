@@ -149,27 +149,46 @@ class GCN(nn.Module):
 class Attention(nn.Module):
     """Post-LN multi-head attention (gnn_transformer.py:124-161): additive
     -1e9 masking where mask==0, softmax, output projection, dropout, residual
-    on the ORIGINAL query, LayerNorm."""
+    on the ORIGINAL query, LayerNorm.
+
+    setup-based (not compact) so the K/V projection is callable separately
+    from the attention itself: the KV-cached beam decode projects each new
+    position once (``project_kv``) and attends over the cache (``attend``)
+    instead of re-running the whole stack on the full prefix. Param names are
+    identical to the previous compact layout (q_proj/k_proj/v_proj/out_proj/
+    norm), so checkpoints and the weight-transplant parity tests are
+    unaffected."""
 
     num_heads: int
     d_model: int
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
-    def __call__(self, query, key, value, mask, *, deterministic: bool):
+    def setup(self):
+        self.q_proj = TorchDense(self.d_model, dtype=self.dtype)
+        self.k_proj = TorchDense(self.d_model, dtype=self.dtype)
+        self.v_proj = TorchDense(self.d_model, dtype=self.dtype)
+        self.out_proj = TorchDense(self.d_model, dtype=self.dtype)
+        self.norm = nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype))
+        self.dropout = nn.Dropout(self.dropout_rate)
+
+    def _split_heads(self, x):
+        B, length = x.shape[0], x.shape[1]
+        d_head = self.d_model // self.num_heads
+        return x.reshape(B, length, self.num_heads, d_head).transpose(0, 2, 1, 3)
+
+    def project_kv(self, key, value):
+        """(B, L, D) inputs -> head-split (B, H, L, d_head) K and V."""
+        return self._split_heads(self.k_proj(key)), \
+            self._split_heads(self.v_proj(value))
+
+    def attend(self, query, k, v, mask, *, deterministic: bool):
+        """Attention over pre-projected K/V (as returned by project_kv)."""
         old_query = query
         B, q_len = query.shape[0], query.shape[1]
-        kv_len = key.shape[1]
         d_head = self.d_model // self.num_heads
 
-        q = TorchDense(self.d_model, dtype=self.dtype, name="q_proj")(query)
-        k = TorchDense(self.d_model, dtype=self.dtype, name="k_proj")(key)
-        v = TorchDense(self.d_model, dtype=self.dtype, name="v_proj")(value)
-        q = q.reshape(B, q_len, self.num_heads, d_head).transpose(0, 2, 1, 3)
-        k = k.reshape(B, kv_len, self.num_heads, d_head).transpose(0, 2, 1, 3)
-        v = v.reshape(B, kv_len, self.num_heads, d_head).transpose(0, 2, 1, 3)
-
+        q = self._split_heads(self.q_proj(query))
         weight = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d_head)
         if mask.ndim < 4:  # (B, kv_len) key-padding mask -> (B,1,1,kv)
             mask = mask[:, None, None, :]
@@ -178,9 +197,13 @@ class Attention(nn.Module):
 
         out = jnp.einsum("bhqk,bhkd->bhqd", weight, v)
         out = out.transpose(0, 2, 1, 3).reshape(B, q_len, self.d_model)
-        out = TorchDense(self.d_model, dtype=self.dtype, name="out_proj")(out)
-        out = nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)
-        return nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype), name="norm")(out + old_query)
+        out = self.out_proj(out)
+        out = self.dropout(out, deterministic=deterministic)
+        return self.norm(out + old_query)
+
+    def __call__(self, query, key, value, mask, *, deterministic: bool):
+        k, v = self.project_kv(key, value)
+        return self.attend(query, k, v, mask, deterministic=deterministic)
 
 
 class FeedForward(nn.Module):

@@ -132,46 +132,101 @@ class Encoder(nn.Module):
 
 class Decoder(nn.Module):
     """gnn_transformer.py:88-122: 6 x {causal self-attn, cross-attn over
-    [diff || sub-token] encoder states, FFN}, all post-LN."""
+    [diff || sub-token] encoder states, FFN}, all post-LN.
+
+    setup-based so the KV-cached decode path (``cross_kv`` once per batch +
+    ``decode_step`` once per position) can reuse the exact same parameters
+    as the full-prefix ``__call__``. Layer scope names (self_attn_i /
+    cross_attn_i / ffn_i / embed) are unchanged from the previous compact
+    layout — checkpoints and parity tests see the same tree."""
 
     cfg: FiraConfig
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
+    def setup(self):
+        cfg = self.cfg
+        # no padding_idx on the decoder embedding (gnn_transformer.py:93-94)
+        self.embed = nn.Embed(
+            cfg.vocab_size, cfg.embedding_dim,
+            embedding_init=torch_embed_init, dtype=self.dtype,
+        )
+        for i in range(cfg.num_layers):
+            # setattr keeps the historical per-layer scope names; Flax
+            # registers setup attribute assignments whatever their spelling
+            setattr(self, f"self_attn_{i}", Attention(
+                num_heads=cfg.num_head, d_model=cfg.embedding_dim,
+                dropout_rate=cfg.dropout_rate, dtype=self.dtype))
+            setattr(self, f"cross_attn_{i}", Attention(
+                num_heads=cfg.num_head, d_model=cfg.embedding_dim,
+                dropout_rate=cfg.dropout_rate, dtype=self.dtype))
+            setattr(self, f"ffn_{i}", FeedForward(
+                d_model=cfg.embedding_dim, mult=cfg.ffn_mult,
+                dropout_rate=cfg.dropout_rate, dtype=self.dtype))
+
+    def _pos_table(self) -> jnp.ndarray:
+        cfg = self.cfg
+        return jnp.asarray(position_encoding(cfg.tar_len, cfg.embedding_dim),
+                           dtype=self.dtype)
+
     def __call__(self, tar, sou_embedding, sou_mask, tar_mask_pad,
                  *, deterministic: bool):
         cfg = self.cfg
-        # no padding_idx on the decoder embedding (gnn_transformer.py:93-94)
-        embed = nn.Embed(
-            cfg.vocab_size, cfg.embedding_dim,
-            embedding_init=torch_embed_init, dtype=self.dtype, name="embed",
-        )
         T = tar.shape[1]
-        pos = jnp.asarray(position_encoding(cfg.tar_len, cfg.embedding_dim),
-                          dtype=self.dtype)
-        x = embed(tar) + pos[None, :T, :]
+        x = self.embed(tar) + self._pos_table()[None, :T, :]
 
         causal = jnp.tril(jnp.ones((T, T), dtype=bool))
         # (B,1,1,T) pad mask AND (1,1,T,T) causal (gnn_transformer.py:117)
         tar_mask = tar_mask_pad[:, None, None, :] & causal[None, None, :, :]
 
         for i in range(cfg.num_layers):
-            x = Attention(
-                num_heads=cfg.num_head, d_model=cfg.embedding_dim,
-                dropout_rate=cfg.dropout_rate, dtype=self.dtype,
-                name=f"self_attn_{i}",
-            )(x, x, x, tar_mask, deterministic=deterministic)
-            x = Attention(
-                num_heads=cfg.num_head, d_model=cfg.embedding_dim,
-                dropout_rate=cfg.dropout_rate, dtype=self.dtype,
-                name=f"cross_attn_{i}",
-            )(x, sou_embedding, sou_embedding, sou_mask, deterministic=deterministic)
-            x = FeedForward(
-                d_model=cfg.embedding_dim, mult=cfg.ffn_mult,
-                dropout_rate=cfg.dropout_rate, dtype=self.dtype,
-                name=f"ffn_{i}",
-            )(x, deterministic=deterministic)
+            x = getattr(self, f"self_attn_{i}")(
+                x, x, x, tar_mask, deterministic=deterministic)
+            x = getattr(self, f"cross_attn_{i}")(
+                x, sou_embedding, sou_embedding, sou_mask,
+                deterministic=deterministic)
+            x = getattr(self, f"ffn_{i}")(x, deterministic=deterministic)
         return x
+
+    def cross_kv(self, sou_embedding):
+        """Per-layer cross-attention K/V of the encoder states, computed
+        once per batch: (L, B, H, S, d_head) x 2. The full-prefix path
+        recomputes these every beam step (the reference recomputes them
+        every step x beam, run_model.py:256)."""
+        ks, vs = [], []
+        for i in range(self.cfg.num_layers):
+            k, v = getattr(self, f"cross_attn_{i}").project_kv(
+                sou_embedding, sou_embedding)
+            ks.append(k)
+            vs.append(v)
+        return jnp.stack(ks), jnp.stack(vs)
+
+    def decode_step(self, tok, pos_idx, k_cache, v_cache, cross_k, cross_v,
+                    sou_mask, self_mask):
+        """One decode position with cached K/V.
+
+        tok: (B, 1) token ids at position ``pos_idx`` (traced scalar);
+        k_cache/v_cache: (L, B, H, tar_len, d_head) self-attention caches;
+        cross_k/cross_v: from :meth:`cross_kv`; self_mask: (B, 1, 1, tar_len)
+        validity of cached positions. Returns (x (B,1,D), k_cache, v_cache)
+        with position ``pos_idx`` of the caches filled.
+
+        Mathematically identical to slicing position ``pos_idx`` out of
+        ``__call__`` over the full prefix: post-LN blocks act per position,
+        and causality makes cached K/V equal recomputed K/V.
+        """
+        x = self.embed(tok) + jax.lax.dynamic_slice_in_dim(
+            self._pos_table(), pos_idx, 1, axis=0)[None, :, :]
+        for i in range(self.cfg.num_layers):
+            sa = getattr(self, f"self_attn_{i}")
+            k_new, v_new = sa.project_kv(x, x)       # (B, H, 1, d_head)
+            k_cache = k_cache.at[i, :, :, pos_idx, :].set(k_new[:, :, 0, :])
+            v_cache = v_cache.at[i, :, :, pos_idx, :].set(v_new[:, :, 0, :])
+            x = sa.attend(x, k_cache[i], v_cache[i], self_mask,
+                          deterministic=True)
+            x = getattr(self, f"cross_attn_{i}").attend(
+                x, cross_k[i], cross_v[i], sou_mask, deterministic=True)
+            x = getattr(self, f"ffn_{i}")(x, deterministic=True)
+        return x, k_cache, v_cache
 
 
 class _ScoreHead(nn.Module):
@@ -206,13 +261,23 @@ class CopyNet(nn.Module):
     impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
-    def __call__(self, source, target):
-        src = TorchDense(self.d_model, use_bias=False, dtype=self.dtype,
-                         name="src_proj")(source)     # (B,S,D)
-        tgt = TorchDense(self.d_model, use_bias=False, dtype=self.dtype,
-                         name="tgt_proj")(target)     # (B,T,D)
-        kernel, bias = _ScoreHead(self.d_model, name="score")()
+    def setup(self):
+        self.src_proj = TorchDense(self.d_model, use_bias=False,
+                                   dtype=self.dtype)
+        self.tgt_proj = TorchDense(self.d_model, use_bias=False,
+                                   dtype=self.dtype)
+        self.score = _ScoreHead(self.d_model)
+        self.gate = TorchDense(2, dtype=self.dtype)
+
+    def project_src(self, source):
+        """(B,S,D) source projection — constant per batch, computed once by
+        the KV-cached decode instead of once per beam step."""
+        return self.src_proj(source)
+
+    def score_gate(self, src, target):
+        """Pointer scores + gate from a pre-projected source."""
+        tgt = self.tgt_proj(target)                   # (B,T,D)
+        kernel, bias = self.score()
         if self.impl == "pallas":
             scores = copy_score.copy_scores(
                 src, tgt, kernel.astype(self.dtype), bias.astype(self.dtype)
@@ -227,12 +292,12 @@ class CopyNet(nn.Module):
             raise ValueError(
                 f"copy_head_impl={self.impl!r} not in {{'xla', 'pallas'}}")
         gate = jax.nn.softmax(
-            TorchDense(2, dtype=self.dtype, name="gate")(target).astype(
-                stable_dtype(self.dtype)
-            ),
-            axis=-1,
+            self.gate(target).astype(stable_dtype(self.dtype)), axis=-1,
         )
         return scores, gate
+
+    def __call__(self, source, target):
+        return self.score_gate(self.project_src(source), target)
 
 
 class FiraModel(nn.Module):
@@ -294,6 +359,35 @@ class FiraModel(nn.Module):
         return jnp.concatenate(
             [gate[:, :, 0:1] * gen, gate[:, :, 1:2] * copy], axis=-1
         )
+
+    def decode_init(self, states):
+        """Everything constant across decode steps, computed once per batch:
+        per-layer cross-attention K/V of the encoder states and the copy
+        head's source projection. The reference recomputes all of it every
+        step x beam (run_model.py:256-259)."""
+        cross_k, cross_v = self.decoder.cross_kv(states)
+        return cross_k, cross_v, self.copy_net.project_src(states)
+
+    def fused_probs_step(self, states, mask, tok, pos_idx, k_cache, v_cache,
+                         cross_k, cross_v, src_proj, self_mask):
+        """One-position fused distribution with KV caching: same math as
+        slicing position ``pos_idx`` out of :meth:`fused_probs`, at O(1)
+        decoder cost per step instead of O(tar_len). Returns
+        (fused (B, 1, V_out), k_cache, v_cache)."""
+        tar_emb, k_cache, v_cache = self.decoder.decode_step(
+            tok, pos_idx, k_cache, v_cache, cross_k, cross_v, mask, self_mask,
+        )
+        gen = jax.nn.softmax(
+            self.out_fc(tar_emb).astype(stable_dtype(self.dtype)), axis=-1
+        )
+        scores, gate = self.copy_net.score_gate(src_proj, tar_emb)
+        scores = jnp.where(mask[:, None, :], scores,
+                           jnp.asarray(-1e9, scores.dtype))
+        copy = jax.nn.softmax(scores.astype(stable_dtype(self.dtype)), axis=-1)
+        fused = jnp.concatenate(
+            [gate[:, :, 0:1] * gen, gate[:, :, 1:2] * copy], axis=-1
+        )
+        return fused, k_cache, v_cache
 
     def fused_log_probs(self, states, mask, tar, tar_mask_pad, *,
                         deterministic: bool = True):

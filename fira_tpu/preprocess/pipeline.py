@@ -116,7 +116,10 @@ def _shard_dir(out_dir: str, begin: int, end: int) -> str:
 def _shard_done(out_dir: str, begin: int, end: int) -> bool:
     # errors.json is part of done-ness: it is always written (possibly []),
     # so a shard that crashed between its stream writes and its error record
-    # reprocesses instead of passing for a clean shard on re-run.
+    # reprocesses instead of passing for a clean shard on re-run. Shard dirs
+    # written before this marker existed also reprocess once — deliberate: a
+    # legacy shard without errors.json is indistinguishable from a crashed
+    # one, and correctness of the error ledger beats one re-run.
     d = _shard_dir(out_dir, begin, end)
     return all(os.path.exists(os.path.join(d, f"{s}.json"))
                for s in GRAPH_STREAMS) \

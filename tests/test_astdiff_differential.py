@@ -1,0 +1,290 @@
+"""Differential tests: astdiff vs GumTree's action semantics (VERDICT r2 #2).
+
+The reference's entire graph corpus came from GumTree 2.1.2 diff output
+consumed through the textual contract of
+/root/reference/Preprocess/get_ast_root_action.py:123-232: action lines
+``Match A(i) to B(j)`` / ``Update A(i) to name`` / ``Move A(i) into P(j) at
+k`` / ``Insert A(i) into P(j) at k`` / ``Delete A(i)``, with Match lines then
+RECLASSIFIED into match/update/move by joining Update/Move lists on the old
+node (:185-222; update wins when a node both moved and renamed, :221-222).
+
+Three layers, so a drift in either half of the contract fails loudly:
+
+  1. classify_actions against hand-built GumTree-format action lines — the
+     reclassification rules themselves, isolated from the matcher.
+  2. A curated Java corpus (rename, statement move, move+rename, method
+     reorder, annotation insert, nested generics, identity) driven through
+     the native matcher end-to-end, asserting the action-level classification
+     GumTree's semantics prescribe.
+  3. The reference's own pathological-input tables (WASTE_TIME: 6 token
+     sequences that hang GumTree's JVM; CHANGE_SINGLE: 4 inputs it must
+     rewrite before parsing, process_data_ast_parallel.py:16-17,38-39,123-124)
+     as TIMED regressions: the native parser must finish fast and either
+     parse or cleanly degrade — never hang, never crash.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from fira_tpu.preprocess import astdiff_binding as astdiff
+from fira_tpu.preprocess import extract
+from fira_tpu.preprocess.extract import Actions, ExtractError, classify_actions
+
+from tests.conftest import REFERENCE_ROOT, reference_available
+
+
+def classified_kinds(actions: Actions) -> dict:
+    """{(old_typ, old_name): {kinds}} — a set per key, since several nodes
+    can share a (type, name) signature (e.g. two ExpressionStatements)."""
+    kinds: dict = {}
+    for kind, old, new in actions.classified:
+        kinds.setdefault((old.typ, old.name), set()).add(kind)
+    return kinds
+
+
+def diff_classify(old_src: str, new_src: str) -> Actions:
+    lines = astdiff.diff_lines(old_src, new_src)
+    assert lines is not None, "both sides must parse"
+    return classify_actions(lines)
+
+
+# --------------------------------------------------------------------------
+# Layer 1: the reclassification rules on the textual contract
+# (get_ast_root_action.py:185-222)
+# --------------------------------------------------------------------------
+
+class TestReclassificationRules:
+    def test_plain_match_stays_match(self):
+        a = classify_actions(["Match SimpleName: x(3) to SimpleName: x(7)"])
+        assert a.classified == [("match",
+                                 extract.Actor("SimpleName", 3, "x"),
+                                 extract.Actor("SimpleName", 7, "x"))]
+
+    def test_match_plus_update_is_update(self):
+        a = classify_actions([
+            "Match SimpleName: x(3) to SimpleName: y(7)",
+            "Update SimpleName: x(3) to y",
+        ])
+        assert [k for k, *_ in a.classified] == ["update"]
+
+    def test_match_plus_move_is_move(self):
+        a = classify_actions([
+            "Match ExpressionStatement(3) to ExpressionStatement(9)",
+            "Move ExpressionStatement(3) into Block(5) at 0",
+        ])
+        assert [k for k, *_ in a.classified] == ["move"]
+
+    def test_update_wins_over_move(self):
+        # :221-222 — a node that both moved and renamed classifies 'update'
+        a = classify_actions([
+            "Match SimpleName: x(3) to SimpleName: y(9)",
+            "Update SimpleName: x(3) to y",
+            "Move SimpleName: x(3) into Block(5) at 1",
+        ])
+        assert [k for k, *_ in a.classified] == ["update"]
+
+    def test_inserts_and_deletes_pass_through(self):
+        a = classify_actions([
+            "Insert SimpleName: z(4) into Block(2) at 0",
+            "Delete SimpleName: w(6)",
+        ])
+        assert a.adds == [extract.Actor("SimpleName", 4, "z")]
+        assert a.deletes == [extract.Actor("SimpleName", 6, "w")]
+        assert a.classified == []
+
+    def test_update_name_must_agree_with_match(self):
+        # the reference asserts value1 == value2 (:199)
+        with pytest.raises(ExtractError):
+            classify_actions([
+                "Match SimpleName: x(3) to SimpleName: z(7)",
+                "Update SimpleName: x(3) to y",
+            ])
+
+    def test_unconsumed_update_rejected(self):
+        # document_update must all be True (:223-224)
+        with pytest.raises(ExtractError):
+            classify_actions(["Update SimpleName: x(3) to y"])
+
+    def test_unconsumed_move_rejected(self):
+        with pytest.raises(ExtractError):
+            classify_actions(["Move SimpleName: x(3) into Block(5) at 0"])
+
+    def test_null_and_this_literals_get_names(self):
+        # get_typ_idx gives NullLiteral name 'null', ThisExpression 'this'
+        # (get_ast_root_action.py:112-121)
+        a = classify_actions(["Match NullLiteral(3) to NullLiteral(9)",
+                              "Match ThisExpression(4) to ThisExpression(10)"])
+        assert a.classified[0][1].name == "null"
+        assert a.classified[1][1].name == "this"
+
+    def test_unrecognized_line_rejected(self):
+        with pytest.raises(ExtractError):
+            classify_actions(["Frobnicate SimpleName: x(3)"])
+
+
+# --------------------------------------------------------------------------
+# Layer 2: curated Java corpus through the native matcher, end to end
+# --------------------------------------------------------------------------
+
+class TestCuratedCorpus:
+    def test_identity_all_match(self):
+        src = "class A { void m(int k) { return; } }"
+        a = diff_classify(src, src)
+        assert a.deletes == [] and a.adds == []
+        assert a.classified and all(k == "match" for k, *_ in a.classified)
+
+    def test_rename_is_single_update(self):
+        a = diff_classify("class A { void m() { int x = 1; } }",
+                          "class A { void m() { int y = 1; } }")
+        kinds = classified_kinds(a)
+        assert kinds[("SimpleName", "x")] == {"update"}
+        others = set().union(*(v for key, v in kinds.items()
+                               if key != ("SimpleName", "x")))
+        assert others <= {"match"}
+        assert a.deletes == [] and a.adds == []
+
+    def test_statement_move_into_new_if(self):
+        a = diff_classify(
+            "class A { void m() { a(); b(); } }",
+            "class A { void m() { if (c) { a(); } b(); } }")
+        kinds = classified_kinds(a)
+        # the a(); statement moved into the inserted if's block, b(); stayed
+        assert kinds[("ExpressionStatement", None)] == {"move", "match"}
+        # ... whose structure arrived as Inserts
+        added = {(n.typ, n.name) for n in a.adds}
+        assert ("IfStatement", None) in added
+        assert ("SimpleName", "c") in added
+        assert a.deletes == []
+
+    def test_move_plus_rename_classifies_update(self):
+        a = diff_classify(
+            "class A { void m() { int x = 1; f(); } }",
+            "class A { void m() { f(); int y = 1; } }")
+        kinds = classified_kinds(a)
+        # the declaration STATEMENT moved ...
+        assert kinds[("VariableDeclarationStatement", None)] == {"move"}
+        # ... and its renamed name leaf is update, not move (:221-222)
+        assert kinds[("SimpleName", "x")] == {"update"}
+
+    def test_method_reorder_is_move_not_churn(self):
+        a = diff_classify(
+            "class A { void p() { a(); } void q() { b(); } }",
+            "class A { void q() { b(); } void p() { a(); } }")
+        kinds = classified_kinds(a)
+        # a stable matcher maps both methods; one sibling registers as
+        # moved, the other as matched, nothing is deleted/re-inserted
+        assert kinds[("MethodDeclaration", None)] == {"move", "match"}
+        all_kinds = set().union(*kinds.values())
+        assert "update" not in all_kinds
+        assert a.deletes == [] and a.adds == []
+        # every method body leaf survived as a match
+        assert kinds[("SimpleName", "a")] == {"match"}
+        assert kinds[("SimpleName", "b")] == {"match"}
+
+    def test_annotation_insert(self):
+        a = diff_classify("class A { void m() { } }",
+                          "class A { @Override void m() { } }")
+        added = {(n.typ, n.name) for n in a.adds}
+        assert ("MarkerAnnotation", None) in added
+        assert ("SimpleName", "Override") in added
+        assert a.deletes == []
+        assert all(k == "match" for k, *_ in a.classified)
+
+    def test_nested_generic_type_update(self):
+        a = diff_classify("class A { Map<String, List<Integer>> f; }",
+                          "class A { Map<String, List<Long>> f; }")
+        kinds = classified_kinds(a)
+        assert kinds[("SimpleName", "Integer")] == {"update"}
+        assert kinds[("SimpleName", "String")] == {"match"}
+        assert a.deletes == [] and a.adds == []
+
+    def test_statement_delete(self):
+        a = diff_classify("class A { void m() { a(); b(); } }",
+                          "class A { void m() { b(); } }")
+        deleted = {(n.typ, n.name) for n in a.deletes}
+        assert ("SimpleName", "a") in deleted
+        assert a.adds == []
+
+    def test_every_update_move_consumed_on_real_diffs(self):
+        # the reference's document_move/document_update asserts (:223-224)
+        # hold on matcher output by construction — classify_actions raising
+        # would mean the matcher emitted an orphan Update/Move
+        pairs = [
+            ("class A { int a; }", "class A { long a; }"),
+            ("class A { void m() { x(); y(); z(); } }",
+             "class A { void m() { z(); x(); y(); } }"),
+            ("class A { }", "class B { int q; }"),
+        ]
+        for old, new in pairs:
+            diff_classify(old, new)  # must not raise
+
+
+# --------------------------------------------------------------------------
+# Layer 3: the reference's pathological inputs, timed
+# --------------------------------------------------------------------------
+
+needs_reference = pytest.mark.skipif(
+    not reference_available(), reason="reference mount unavailable")
+
+# generous wall-clock bound per input: these hang GumTree's JVM for minutes;
+# the native path measures in fractions of a millisecond
+PATHOLOGICAL_BUDGET_S = 10.0
+
+
+def _load(name):
+    with open(os.path.join(REFERENCE_ROOT, "Preprocess", name)) as f:
+        return json.load(f)
+
+
+@needs_reference
+class TestPathologicalInputs:
+    def test_waste_time_sequences_finish_fast(self):
+        # 6 token sequences the reference blocklists because GumTree hangs
+        # (process_data_ast_parallel.py:16,123-124). The native parser must
+        # terminate promptly, parsing or cleanly degrading to no-AST.
+        for i, seq in enumerate(_load("WASTE_TIME")):
+            t0 = time.perf_counter()
+            text, side = extract.parse_fragment(seq)
+            dt = time.perf_counter() - t0
+            assert dt < PATHOLOGICAL_BUDGET_S, f"WASTE_TIME[{i}] took {dt:.1f}s"
+            if text is None:
+                assert side.ast_tokens == []  # clean degradation
+            else:
+                assert side.ast_tokens  # real parse produced AST nodes
+
+    def test_waste_time_through_update_chunk(self):
+        # full diff contract on every ordered pair — bounded, no hang, and
+        # any change labels stay within the closed label set
+        seqs = _load("WASTE_TIME")
+        t0 = time.perf_counter()
+        for old in seqs:
+            for new in seqs:
+                g = extract.update_chunk_edges(old, new)
+                assert set(g.change) <= {"match", "update", "move",
+                                         "delete", "add"}
+        dt = time.perf_counter() - t0
+        assert dt < PATHOLOGICAL_BUDGET_S * len(seqs), f"{dt:.1f}s for 36 diffs"
+
+    def test_change_single_originals_and_rewrites(self):
+        # the 4 inputs the reference must rewrite before GumTree accepts
+        # them (:17,38-39). The native parser takes each ORIGINAL directly:
+        # parse or clean degrade, fast, no table needed; the REWRITES (what
+        # the reference actually fed GumTree) must behave no worse.
+        originals, rewrites = _load("CHANGE_SINGLE")
+        assert len(originals) == len(rewrites) == 4
+        for i, (orig, rewrite) in enumerate(zip(originals, rewrites)):
+            t0 = time.perf_counter()
+            o_text, o_side = extract.parse_fragment(orig)
+            r_text, r_side = extract.parse_fragment(rewrite)
+            dt = time.perf_counter() - t0
+            assert dt < PATHOLOGICAL_BUDGET_S, f"CHANGE_SINGLE[{i}] {dt:.1f}s"
+            for text, side in ((o_text, o_side), (r_text, r_side)):
+                if text is None:
+                    assert side.ast_tokens == []
+            # diffing original vs rewrite exercises the degraded-side path
+            g = extract.update_chunk_edges(orig, rewrite)
+            assert set(g.change) <= {"match", "update", "move",
+                                     "delete", "add"}

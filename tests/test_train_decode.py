@@ -19,7 +19,7 @@ from fira_tpu.data.batching import make_batch
 from fira_tpu.data.dataset import FiraDataset
 from fira_tpu.data.synthetic import write_corpus_dir
 from fira_tpu.data.vocab import EOS_ID, PAD_ID, START_ID
-from fira_tpu.decode.beam import beam_search, make_beam_search
+from fira_tpu.decode.beam import beam_search, beam_search_cached, make_beam_search
 from fira_tpu.model.model import FiraModel
 from fira_tpu.parallel import mesh as pmesh
 from fira_tpu.train import step as step_lib
@@ -231,6 +231,30 @@ def test_beam_matches_reference_loop(tiny_setup, tiny_model_state):
             i, jit_seq, ref_gen[i][best_ref])
         np.testing.assert_allclose(probs[i, best_jit],
                                    ref_prob[i][best_ref], rtol=1e-5)
+
+
+def test_kv_cached_beam_matches_full_redecode(tiny_setup, tiny_model_state):
+    """The KV-cached scan must reproduce the full-prefix re-decode beam
+    exactly: same tokens, same scores (VERDICT r2 #3) — in the reference's
+    prob-space compat mode AND in log-space mode."""
+    import dataclasses
+
+    dataset = tiny_setup
+    model, state, _ = tiny_model_state
+    test_split = dataset.splits["test"]
+
+    for compat in (True, False):
+        cfg = dataclasses.replace(dataset.cfg, beam_compat_prob_space=compat)
+        batch = make_batch(test_split, np.arange(min(4, len(test_split))), cfg)
+        tok_full, p_full = jax.jit(
+            lambda p, b: beam_search(model, p, b, cfg)
+        )(state.params, batch)
+        tok_kv, p_kv = jax.jit(
+            lambda p, b: beam_search_cached(model, p, b, cfg)
+        )(state.params, batch)
+        np.testing.assert_array_equal(np.asarray(tok_full), np.asarray(tok_kv))
+        np.testing.assert_allclose(np.asarray(p_full), np.asarray(p_kv),
+                                   rtol=2e-5, atol=1e-7)
 
 
 def test_train_end_to_end_tiny(tmp_path, tiny_setup):
