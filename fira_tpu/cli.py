@@ -68,6 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--copy-head", default=None, choices=["xla", "pallas"],
                    help="pointer-score impl: XLA (materialized intermediate) "
                         "or the fused Pallas kernel")
+    p.add_argument("--seq-shards", type=int, default=None, metavar="N",
+                   help="ring-attention sequence parallelism: shard decoder "
+                        "cross-attention K/V over N devices (long-context "
+                        "scaling; 0/1 = dense attention)")
     p.add_argument("--profile-dir", default=None,
                    help="train: write a jax.profiler trace of a steady-state "
                         "step window here (TensorBoard-loadable)")
@@ -92,6 +96,8 @@ def _resolve_cfg(args):
         overrides["adjacency_impl"] = args.adjacency
     if args.copy_head:
         overrides["copy_head_impl"] = args.copy_head
+    if args.seq_shards is not None:
+        overrides["seq_shards"] = args.seq_shards
     return cfg.replace(**overrides) if overrides else cfg
 
 

@@ -75,6 +75,14 @@ class FiraConfig:
                                          # (run_model.py:271,305); False => log-space
     beam_kv_cache: bool = True  # O(T) cached decode vs full-prefix re-decode
 
+    # --- long context ---
+    # >1 routes decoder cross-attention through ring attention
+    # (parallel/ring.py) over a (data, seq) mesh with that many sequence
+    # shards: K/V blocks rotate on the ICI ring, peak attention memory drops
+    # to O(T_local^2) per device. 0/1 = dense attention (FIRA's 370-key
+    # geometry fits one chip; the knob is the long-context scaling path).
+    seq_shards: int = 0
+
     @property
     def graph_len(self) -> int:
         # 650 = 210 + 160 + 280 (run_model.py note; paper §5.4 "up to 650 nodes")

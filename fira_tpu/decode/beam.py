@@ -172,10 +172,10 @@ def beam_search_cached(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
 
     states, mask = model.apply({"params": params}, batch,
                                method=FiraModel.encode)
-    states_k = jnp.repeat(states, K, axis=0)
     mask_k = jnp.repeat(mask, K, axis=0)
     # project once per ITEM, then replicate per beam — beams share encoder
-    # states, so projecting states_k would do K-fold duplicate matmuls
+    # states, so projecting after the beam fold would do K-fold duplicate
+    # matmuls (the raw states themselves are not needed per step at all)
     cross_k, cross_v, src_proj = model.apply(
         {"params": params}, states, method=FiraModel.decode_init)
     cross_k = jnp.repeat(cross_k, K, axis=1)   # (L, B*K, H, S, d_head)
@@ -193,7 +193,7 @@ def beam_search_cached(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
         valid = (flat != 0).at[:, 0].set(True) & (jnp.arange(T)[None, :] <= s)
         tok_in = jax.lax.dynamic_slice_in_dim(flat, s, 1, axis=1)  # (B*K, 1)
         fused, k_cache, v_cache = model.apply(
-            {"params": params}, states_k, mask_k, tok_in, s,
+            {"params": params}, mask_k, tok_in, s,
             k_cache, v_cache, cross_k, cross_v, src_proj,
             valid[:, None, None, :],
             method=FiraModel.fused_probs_step,

@@ -12,7 +12,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from fira_tpu.eval import bnorm_bleu, meteor_files, penalty_bleu, rouge_l_files
+from fira_tpu.eval import bnorm_bleu, penalty_bleu, rouge_l_files
 
 
 def main(argv=None) -> int:
@@ -38,7 +38,16 @@ def main(argv=None) -> int:
     elif args.cmd == "rouge":
         print(rouge_l_files(args.gen_path, args.ref_path))
     elif args.cmd == "meteor":
-        print(meteor_files(args.gen_path, args.ref_path))
+        from fira_tpu.eval.meteor import meteor_detail
+
+        with open(args.gen_path) as h, open(args.ref_path) as r:
+            d = meteor_detail(h.read().split("\n"), r.read().split("\n"))
+        if not d["wordnet"]:
+            print("WARNING: wordnet corpus unavailable - native exact+stem "
+                  "METEOR (strict lower bound, ~0.5 below the "
+                  "wordnet-complete value; see eval/meteor.py)",
+                  file=sys.stderr)
+        print(d["value"])
     return 0
 
 

@@ -163,6 +163,11 @@ class Attention(nn.Module):
     d_model: int
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
+    # a (data, seq) jax.sharding.Mesh routes this module's attention core
+    # through ring attention (parallel/ring.py) whenever the mask is a pure
+    # key-padding mask and both sequence lengths divide the seq axis; adds
+    # no parameters, so checkpoints are interchangeable with dense attention
+    ring_mesh: object = None
 
     def setup(self):
         self.q_proj = TorchDense(self.d_model, dtype=self.dtype)
@@ -171,6 +176,18 @@ class Attention(nn.Module):
         self.out_proj = TorchDense(self.d_model, dtype=self.dtype)
         self.norm = nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype))
         self.dropout = nn.Dropout(self.dropout_rate)
+
+    def _ring_applicable(self, q, k, mask) -> bool:
+        if self.ring_mesh is None or mask.ndim != 2:
+            # 4D masks (causal self-attention) stay on the dense path; ring
+            # carries key-padding semantics only
+            return False
+        from fira_tpu.parallel.ring import SEQ_AXIS
+
+        n_seq = self.ring_mesh.shape[SEQ_AXIS]
+        n_data = self.ring_mesh.shape["data"]
+        return (q.shape[2] % n_seq == 0 and k.shape[2] % n_seq == 0
+                and q.shape[0] % n_data == 0)
 
     def _split_heads(self, x):
         B, length = x.shape[0], x.shape[1]
@@ -189,13 +206,20 @@ class Attention(nn.Module):
         d_head = self.d_model // self.num_heads
 
         q = self._split_heads(self.q_proj(query))
-        weight = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d_head)
-        if mask.ndim < 4:  # (B, kv_len) key-padding mask -> (B,1,1,kv)
-            mask = mask[:, None, None, :]
-        weight = jnp.where(mask == 0, jnp.asarray(-1e9, weight.dtype), weight)
-        weight = jax.nn.softmax(weight.astype(stable_dtype(self.dtype)), axis=-1).astype(self.dtype)
+        if self._ring_applicable(q, k, mask):
+            # sequence-parallel exact attention: K/V blocks rotate over the
+            # seq mesh axis with an online softmax (same -1e9 key-padding
+            # semantics as the dense branch below)
+            from fira_tpu.parallel.ring import ring_attention_sharded
 
-        out = jnp.einsum("bhqk,bhkd->bhqd", weight, v)
+            out = ring_attention_sharded(q, k, v, mask != 0, self.ring_mesh)
+        else:
+            weight = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d_head)
+            if mask.ndim < 4:  # (B, kv_len) key-padding mask -> (B,1,1,kv)
+                mask = mask[:, None, None, :]
+            weight = jnp.where(mask == 0, jnp.asarray(-1e9, weight.dtype), weight)
+            weight = jax.nn.softmax(weight.astype(stable_dtype(self.dtype)), axis=-1).astype(self.dtype)
+            out = jnp.einsum("bhqk,bhkd->bhqd", weight, v)
         out = out.transpose(0, 2, 1, 3).reshape(B, q_len, self.d_model)
         out = self.out_proj(out)
         out = self.dropout(out, deterministic=deterministic)

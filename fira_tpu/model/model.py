@@ -142,6 +142,7 @@ class Decoder(nn.Module):
 
     cfg: FiraConfig
     dtype: jnp.dtype = jnp.float32
+    ring_mesh: object = None  # (data, seq) mesh for cross-attention SP
 
     def setup(self):
         cfg = self.cfg
@@ -156,9 +157,13 @@ class Decoder(nn.Module):
             setattr(self, f"self_attn_{i}", Attention(
                 num_heads=cfg.num_head, d_model=cfg.embedding_dim,
                 dropout_rate=cfg.dropout_rate, dtype=self.dtype))
+            # only cross-attention rides the ring: its key axis ([diff||sub]
+            # source states) is the one that grows with context length;
+            # causal self-attention (4D mask) stays dense regardless
             setattr(self, f"cross_attn_{i}", Attention(
                 num_heads=cfg.num_head, d_model=cfg.embedding_dim,
-                dropout_rate=cfg.dropout_rate, dtype=self.dtype))
+                dropout_rate=cfg.dropout_rate, dtype=self.dtype,
+                ring_mesh=self.ring_mesh))
             setattr(self, f"ffn_{i}", FeedForward(
                 d_model=cfg.embedding_dim, mult=cfg.ffn_mult,
                 dropout_rate=cfg.dropout_rate, dtype=self.dtype))
@@ -308,8 +313,20 @@ class FiraModel(nn.Module):
 
     def setup(self):
         cfg = self.cfg
+        ring_mesh = None
+        if cfg.seq_shards > 1:
+            from fira_tpu.parallel.ring import seq_mesh
+            import jax as _jax
+
+            n_dev = len(_jax.devices())
+            if n_dev % cfg.seq_shards:
+                raise ValueError(
+                    f"seq_shards={cfg.seq_shards} does not divide the "
+                    f"{n_dev} visible devices")
+            ring_mesh = seq_mesh(n_data=n_dev // cfg.seq_shards,
+                                 n_seq=cfg.seq_shards)
         self.encoder = Encoder(cfg, dtype=self.dtype)
-        self.decoder = Decoder(cfg, dtype=self.dtype)
+        self.decoder = Decoder(cfg, dtype=self.dtype, ring_mesh=ring_mesh)
         self.copy_net = CopyNet(cfg.embedding_dim, impl=cfg.copy_head_impl,
                                 dtype=self.dtype)
         self.out_fc = TorchDense(cfg.vocab_size, dtype=self.dtype)
@@ -368,7 +385,7 @@ class FiraModel(nn.Module):
         cross_k, cross_v = self.decoder.cross_kv(states)
         return cross_k, cross_v, self.copy_net.project_src(states)
 
-    def fused_probs_step(self, states, mask, tok, pos_idx, k_cache, v_cache,
+    def fused_probs_step(self, mask, tok, pos_idx, k_cache, v_cache,
                          cross_k, cross_v, src_proj, self_mask):
         """One-position fused distribution with KV caching: same math as
         slicing position ``pos_idx`` out of :meth:`fused_probs`, at O(1)

@@ -143,3 +143,59 @@ class TestSegmentAdjacency:
         assert int(cnt_d) == int(cnt_s)
         np.testing.assert_allclose(float(nll_d), float(nll_s),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestModelRingIntegration:
+    """seq_shards routes FiraModel decoder cross-attention through ring
+    attention (VERDICT r2 #5): same params, same outputs as dense."""
+
+    def _setup(self, seq_shards):
+        from fira_tpu.config import fira_tiny
+        from fira_tpu.data.synthetic import make_memory_batch
+        from fira_tpu.model.model import FiraModel
+
+        # batch 8 = data axis 4 x 2; S = 32+24 = 56 and tar 12 divide seq=2
+        cfg = fira_tiny(batch_size=8)
+        cfg, batch, _ = make_memory_batch(cfg, n=cfg.batch_size)
+        return FiraModel(cfg.replace(seq_shards=seq_shards)), cfg, batch
+
+    def test_loss_matches_dense(self):
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        model_d, cfg, batch = self._setup(0)
+        params = model_d.init(jax.random.PRNGKey(0), batch,
+                              deterministic=True)["params"]
+        nll_d, cnt_d = model_d.apply({"params": params}, batch,
+                                     deterministic=True)
+        model_r, _, _ = self._setup(2)
+        nll_r, cnt_r = model_r.apply({"params": params}, batch,
+                                     deterministic=True)
+        assert int(cnt_d) == int(cnt_r)
+        np.testing.assert_allclose(float(nll_d), float(nll_r),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_beam_decode_matches_dense(self):
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices")
+        from fira_tpu.decode.beam import beam_search
+        from fira_tpu.model.model import FiraModel
+
+        model_d, cfg, batch = self._setup(0)
+        params = model_d.init(jax.random.PRNGKey(0), batch,
+                              deterministic=True)["params"]
+        cfg_r = cfg.replace(seq_shards=2)
+        model_r = FiraModel(cfg_r)
+        # full-prefix beam exercises the ring path with tar-length queries
+        # (the KV-cached path's single-position queries fall back to dense)
+        tok_d, p_d = beam_search(model_d, params, batch, cfg)
+        tok_r, p_r = beam_search(model_r, params, batch, cfg_r)
+        np.testing.assert_array_equal(np.asarray(tok_d), np.asarray(tok_r))
+        np.testing.assert_allclose(np.asarray(p_d), np.asarray(p_r),
+                                   rtol=2e-5, atol=1e-6)
+
+    def test_indivisible_devices_raise(self):
+        import pytest as _pytest
+
+        model, cfg, batch = self._setup(3)  # 8 devices % 3 != 0
+        with _pytest.raises(ValueError, match="seq_shards"):
+            model.init(jax.random.PRNGKey(0), batch, deterministic=True)
