@@ -38,10 +38,9 @@ def main(argv=None) -> int:
     elif args.cmd == "rouge":
         print(rouge_l_files(args.gen_path, args.ref_path))
     elif args.cmd == "meteor":
-        from fira_tpu.eval.meteor import meteor_detail
+        from fira_tpu.eval.meteor import meteor_detail_files
 
-        with open(args.gen_path) as h, open(args.ref_path) as r:
-            d = meteor_detail(h.read().split("\n"), r.read().split("\n"))
+        d = meteor_detail_files(args.gen_path, args.ref_path)
         if not d["wordnet"]:
             print("WARNING: wordnet corpus unavailable - native exact+stem "
                   "METEOR (strict lower bound, ~0.5 below the "

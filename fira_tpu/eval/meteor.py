@@ -111,8 +111,12 @@ def meteor(hyp_lines: Iterable[str], ref_lines: Iterable[str]) -> float:
     return meteor_detail(hyp_lines, ref_lines)["value"]
 
 
-def meteor_files(hyp_path: str, ref_path: str) -> float:
+def meteor_detail_files(hyp_path: str, ref_path: str) -> dict:
     # reference splits on "\n" (Meteor.py:9-10), pairing trailing empty strings
     # too; zip() truncates to the shorter list the same way.
     with open(hyp_path) as h, open(ref_path) as r:
-        return meteor(h.read().split("\n"), r.read().split("\n"))
+        return meteor_detail(h.read().split("\n"), r.read().split("\n"))
+
+
+def meteor_files(hyp_path: str, ref_path: str) -> float:
+    return meteor_detail_files(hyp_path, ref_path)["value"]

@@ -222,5 +222,8 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                     f"(starts at step {profile_window[0]})")
 
     cps = meter.summary()["items_per_sec"] / n_chips
-    return TrainResult(state=state, best_bleu=best_bleu, epochs_run=n_epochs,
+    # epochs ACTUALLY executed this call (a resumed run skips start_epoch of
+    # them) — callers validating resume legs depend on the distinction
+    return TrainResult(state=state, best_bleu=best_bleu,
+                       epochs_run=n_epochs - start_epoch,
                        commits_per_sec_per_chip=cps)

@@ -77,10 +77,23 @@ def main() -> None:
 
     t0 = time.time()
     os.makedirs(base, exist_ok=True)
-    if not os.path.exists(os.path.join(data_dir, "difftoken.json")):
+    # each invocation is a FULL rehearsal: stale checkpoints/outputs from a
+    # previous run would turn both legs into no-ops and void the resume proof
+    for d in (ckpt_dir, out_dir):
+        if os.path.exists(d):
+            import shutil
+
+            shutil.rmtree(d)
+    # corpus readiness is gated on a sentinel written AFTER the vocab
+    # padding, so an interrupted first run regenerates instead of limping on
+    # a half-built directory
+    sentinel = os.path.join(data_dir, ".corpus_ready")
+    if not os.path.exists(sentinel):
         write_corpus_dir(data_dir, n_commits, seed=11)
         pad_vocab_file(os.path.join(data_dir, "word_vocab.json"),
                        REHEARSAL_VOCAB)
+        with open(sentinel, "w") as f:
+            f.write("ok\n")
     # flagship geometry; dev gate made reachable within the short run
     # (reference cadence epoch>=15 %10 is config, run_model.py:89)
     cfg = fira_full(batch_size=batch_size,
@@ -113,8 +126,14 @@ def main() -> None:
     t0 = time.time()
     res_b = train(dataset, out_dir=out_dir, ckpt_dir=ckpt_dir,
                   epochs=epochs_a + epochs_b, var_maps=var_maps, resume=True)
-    assert int(res_b.state.step) > int(res_a.state.step), \
-        "resume leg must continue past leg A's step"
+    # resume PROOF: leg B must have executed exactly epochs_b epochs' worth
+    # of steps on top of leg A's final step — a silent from-scratch restart
+    # would add epochs_a + epochs_b epochs and fail both checks
+    steps_per_epoch = -(-len(dataset.splits["train"]) // cfg.batch_size)
+    delta = int(res_b.state.step) - int(res_a.state.step)
+    assert delta == epochs_b * steps_per_epoch, \
+        f"resume leg ran {delta} steps, expected {epochs_b}x{steps_per_epoch}"
+    assert res_b.epochs_run == epochs_b, res_b.epochs_run
     report["leg_b"] = {
         "epochs_run": res_b.epochs_run,
         "best_dev_bleu": round(res_b.best_bleu, 4),

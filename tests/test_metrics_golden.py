@@ -119,3 +119,18 @@ def test_method2_matches_nltk():
 
 def test_nltk_sentence_bleu_smoke():
     assert nltk_sentence_bleu([["fix", "bug"]], ["fix", "bug"]) > 0.5
+
+
+@needs_ref
+def test_human_eval_aggregation_matches_table6():
+    """eval/human_eval.py reproduces the paper's Table 6 means from the
+    shipped per-rater CSVs (FIRA 2.15 / CODISUM 2.06 / NNGen 0.98)."""
+    from fira_tpu.eval.human_eval import aggregate
+
+    result = aggregate(os.path.join(REFERENCE_ROOT, "HumanEvaluation"))
+    assert result["FIRA"]["mean"] == pytest.approx(2.1533, abs=1e-4)
+    assert result["CODISUM"]["mean"] == pytest.approx(2.0567, abs=1e-4)
+    assert result["NNGen"]["mean"] == pytest.approx(0.985, abs=1e-4)
+    assert all(v["n"] == 600 for v in result.values())  # 100 commits x 6 raters
+    # every rater contributes a per-rater mean
+    assert all(len(v["per_rater"]) == 6 for v in result.values())
