@@ -252,3 +252,43 @@ class TestCliContract:
                              capture_output=True, text=True, check=True)
         got = [ln for ln in out.stdout.splitlines() if ln.strip()]
         assert got == lib.diff_lines(OLD_SRC, NEW_SRC)
+
+
+class TestThreadSafety:
+    def test_concurrent_parse_and_diff(self, lib):
+        """The in-process library must be thread-safe: ctypes releases the
+        GIL during foreign calls, and the training stack's host threads (JAX
+        dispatch, data workers) may overlap astdiff use. The C++ keeps no
+        global state (capi.cpp allocates per call); this pins that property
+        under real concurrency with result-equality against the
+        single-threaded baseline."""
+        import threading
+
+        from fira_tpu.preprocess import astdiff_binding as ab
+
+        srcs = [
+            f"class A{i} {{ int f{i}; void m{i}(int x) {{ "
+            f"return; }} }}" for i in range(8)
+        ]
+        want_parse = [ab.parse_json(s) for s in srcs]
+        want_diff = [ab.diff_lines(srcs[i], srcs[(i + 1) % len(srcs)])
+                     for i in range(len(srcs))]
+
+        errors = []
+
+        def work(tid):
+            try:
+                for it in range(25):
+                    i = (tid + it) % len(srcs)
+                    assert ab.parse_json(srcs[i]) == want_parse[i]
+                    got = ab.diff_lines(srcs[i], srcs[(i + 1) % len(srcs)])
+                    assert got == want_diff[i]
+            except Exception as e:  # pragma: no cover - failure reporting
+                errors.append((tid, repr(e)))
+
+        threads = [threading.Thread(target=work, args=(t,)) for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
