@@ -1,0 +1,78 @@
+"""Property-based tests (hypothesis): the FSM's global round-trip invariant
+over randomized diff structures, and a fuzz of the in-process C++ parser —
+regression surface the example-based suites cannot cover.
+
+The FSM property IS the reference's own global assert
+(process_data_ast_parallel.py:420: reassembled tokens == difftoken stream),
+here quantified over generated inputs instead of one corpus.
+"""
+
+from hypothesis import given, settings, strategies as st
+
+from fira_tpu.preprocess import extract
+from fira_tpu.preprocess.fsm import flatten_chunks, split_hunks
+
+# --- generators -----------------------------------------------------------
+
+_WORD = st.sampled_from(
+    ["int", "x", "y", "=", "1", ";", "foo", "(", ")", "{", "}", "if",
+     "return", ".", "+", "bar", "STRING0", "NUMBER1", ","])
+
+
+def _run(kind_mark):
+    # one run of 1-5 same-mark tokens, optionally closed by a same-mark <nl>
+    return st.tuples(
+        st.lists(_WORD, min_size=1, max_size=5),
+        st.booleans(),
+    ).map(lambda t: [(tok, kind_mark) for tok in t[0]]
+          + ([("<nl>", kind_mark)] if t[1] else []))
+
+
+def _header():
+    # <nb> header block: all context (mark 2) through its closing <nl>
+    return st.lists(_WORD, min_size=0, max_size=3).map(
+        lambda ws: [("<nb>", 2)] + [(w, 2) for w in ws] + [("<nl>", 2)])
+
+
+_STREAM = st.lists(
+    st.one_of(_header(), _run(1), _run(2), _run(3)),
+    min_size=1, max_size=8,
+).map(lambda blocks: [tm for block in blocks for tm in block])
+
+
+# --- properties -----------------------------------------------------------
+
+@settings(max_examples=300, deadline=None)
+@given(_STREAM)
+def test_fsm_roundtrip_and_typing(stream):
+    tokens = [t for t, _ in stream]
+    marks = [m for _, m in stream]
+    chunks, types = split_hunks(tokens, marks)
+    # the reference's global invariant, quantified
+    assert flatten_chunks(chunks, types) == tokens
+    assert set(types) <= {0, -1, 1, 100}
+    for chunk, t in zip(chunks, types):
+        if t == 100:  # update = non-empty delete run + non-empty add run
+            assert chunk[0] and chunk[1]
+        else:
+            assert chunk
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.lists(st.one_of(
+    _WORD,
+    st.sampled_from(["<nb>", "<nl>", "COMMENT", "SINGLE", "@", "<", ">",
+                     "]", "[", "`", "$", "\\", "'", '"', "implements"]),
+    st.text(alphabet="abc{}();=<>.!0", min_size=1, max_size=6),
+), min_size=0, max_size=30))
+def test_parse_fragment_never_crashes(tokens):
+    """Fuzz the full reconstruct->C++ parse->leaf-map path: any token list
+    must either produce a parse (with AST nodes) or cleanly degrade to
+    (None, empty side) — never throw an unexpected exception and never
+    crash the process (the parser runs in-process via ctypes, so a C++
+    fault here would take pytest down with it)."""
+    text, side = extract.parse_fragment(tokens)
+    if text is None:
+        assert side.ast_tokens == []
+    else:
+        assert side.ast_tokens
