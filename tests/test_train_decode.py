@@ -304,3 +304,25 @@ def test_fira_large_mesh_step():
     )(state.params, batch)
     assert tokens.shape == (8, 8, cfg.tar_len)
     assert np.isfinite(np.asarray(probs)).all()
+
+
+@pytest.mark.parametrize("ablation", ["no_edit", "no_subtoken", "nothing"])
+def test_ablation_configs_train_and_decode(tiny_setup, ablation):
+    """The three paper Table 3 ablations run end-to-end: one train step
+    (finite loss) and a beam decode at the ablated geometry."""
+    from fira_tpu.config import apply_ablation
+
+    dataset = tiny_setup
+    cfg = apply_ablation(dataset.cfg, ablation)
+    split = dataset.splits["train"]
+    batch = make_batch(split, np.arange(cfg.batch_size), cfg)
+    model = FiraModel(cfg)
+    state = init_state(model, cfg, batch)
+    train_step = jax.jit(step_lib.make_train_step(model, cfg))
+    state, metrics = train_step(state, batch)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    tokens, probs = jax.jit(
+        lambda p, b: beam_search_cached(model, p, b, cfg)
+    )(state.params, batch)
+    assert tokens.shape == (cfg.batch_size, cfg.beam_size, cfg.tar_len)
+    assert np.isfinite(np.asarray(probs)).all()
