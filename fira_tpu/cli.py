@@ -173,15 +173,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     import numpy as np
 
     ckpt = CheckpointManager(ckpt_dir)
-    if not ckpt.has(CheckpointManager.BEST):
-        print(f"no best checkpoint under {ckpt_dir}; train first", file=sys.stderr)
+    use_best = ckpt.has(CheckpointManager.BEST)
+    if not use_best and not ckpt.has(CheckpointManager.LATEST):
+        print(f"no checkpoint under {ckpt_dir}; train first", file=sys.stderr)
         return 1
-    model = FiraModel(cfg)
+    import jax.numpy as jnp
+
+    # honor --dtype for decode too, not just training (params stay f32)
+    model = FiraModel(cfg, dtype=jnp.dtype(cfg.compute_dtype))
     split = dataset.splits["test"]
     sample = make_batch(split, np.arange(min(cfg.test_batch_size, len(split))),
                         cfg, batch_size=cfg.test_batch_size)
     template = init_state(model, cfg, sample)
-    params = ckpt.restore_best(template.params)
+    if use_best:
+        params = ckpt.restore_best(template.params)
+    else:
+        # the dev gate saves best only on STRICT improvement (reference
+        # run_model.py:94-96), so a short run whose dev BLEU never left 0.0
+        # has no best yet — decode the latest state instead of refusing
+        print("no best checkpoint (dev BLEU never improved); "
+              "decoding the LATEST training state", file=sys.stderr)
+        params = ckpt.restore_latest(template)[0].params
     metrics = run_test(model, params, dataset, cfg, out_dir=args.out_dir,
                        ablation=args.ablation, var_maps=var_maps)
     print(f"test sentence-bleu: {metrics['sentence_bleu']:.4f} "
