@@ -326,3 +326,22 @@ def test_ablation_configs_train_and_decode(tiny_setup, ablation):
     )(state.params, batch)
     assert tokens.shape == (cfg.batch_size, cfg.beam_size, cfg.tar_len)
     assert np.isfinite(np.asarray(probs)).all()
+
+
+def test_f32_checkpoint_decodes_in_bf16(tmp_path, tiny_setup, tiny_model_state):
+    """Params checkpointed in f32 restore into a bf16-compute model and beam
+    decode (the --dtype bfloat16 test path: params stay f32, compute casts)."""
+    model_f32, state, batch = tiny_model_state
+    dataset = tiny_setup
+    cfg = dataset.cfg
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+    ckpt.save_best(state.params)
+
+    model_bf16 = FiraModel(cfg, dtype=jnp.bfloat16)
+    template = init_state(model_bf16, cfg, batch)
+    params = ckpt.restore_best(template.params)
+    tokens, probs = jax.jit(
+        lambda p, b: beam_search_cached(model_bf16, p, b, cfg)
+    )(params, batch)
+    assert tokens.shape == (cfg.batch_size, cfg.beam_size, cfg.tar_len)
+    assert np.isfinite(np.asarray(probs, np.float32)).all()
