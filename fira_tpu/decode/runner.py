@@ -43,31 +43,37 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
 
     os.makedirs(out_dir, exist_ok=True)
     out_path = os.path.join(out_dir, output_name(ablation))
-    lines: List[str] = []
+    # stream to a .partial file, atomically renamed on completion: full-size
+    # decodes run for tens of minutes and a crash must not cost every line
+    partial_path = out_path + ".partial"
     total_bleu, n = 0.0, 0
     cursor = 0
-    for batch in epoch_batches(data, cfg, batch_size=cfg.test_batch_size):
-        tokens, probs = beam(params, batch)
-        tokens = np.asarray(jax.device_get(tokens))
-        probs = np.asarray(jax.device_get(probs))
-        valid = np.asarray(batch["valid"])
-        for i in range(tokens.shape[0]):
-            if not valid[i]:
-                continue
-            best = int(np.argmax(probs[i]))          # run_model.py:351
-            ids = tokens[i, best].tolist()
-            # beam output ids are already copy-resolved at extension time
-            hyp = cook_prediction(ids[1:], batch["diff"][i],
-                                  batch["sub_token"][i], vocab, cfg,
-                                  resolve=False)
-            ref = reference_words(batch["msg"][i], vocab)
-            total_bleu += nltk_sentence_bleu([ref], hyp)
-            n += 1
-            var_map = (var_maps[indices[cursor]]
-                       if var_maps is not None else None)
-            lines.append(" ".join(deanonymize(hyp, var_map)))
-            cursor += 1
-    with open(out_path, "w") as f:
-        f.write("\n".join(lines) + "\n")
+    n_total = len(data)
+    with open(partial_path, "w") as out_f:
+        for batch in epoch_batches(data, cfg, batch_size=cfg.test_batch_size):
+            tokens, probs = beam(params, batch)
+            tokens = np.asarray(jax.device_get(tokens))
+            probs = np.asarray(jax.device_get(probs))
+            valid = np.asarray(batch["valid"])
+            for i in range(tokens.shape[0]):
+                if not valid[i]:
+                    continue
+                best = int(np.argmax(probs[i]))      # run_model.py:351
+                ids = tokens[i, best].tolist()
+                # beam output ids are already copy-resolved at extension time
+                hyp = cook_prediction(ids[1:], batch["diff"][i],
+                                      batch["sub_token"][i], vocab, cfg,
+                                      resolve=False)
+                ref = reference_words(batch["msg"][i], vocab)
+                total_bleu += nltk_sentence_bleu([ref], hyp)
+                n += 1
+                var_map = (var_maps[indices[cursor]]
+                           if var_maps is not None else None)
+                out_f.write(" ".join(deanonymize(hyp, var_map)) + "\n")
+                cursor += 1
+            if n and n % 1000 < cfg.test_batch_size:
+                out_f.flush()
+                print(f"decode: {n}/{n_total}", flush=True)
+    os.replace(partial_path, out_path)
     return {"sentence_bleu": total_bleu / max(n, 1), "n": float(n),
             "output_path": out_path}  # type: ignore[return-value]
