@@ -68,6 +68,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--copy-head", default=None, choices=["xla", "pallas"],
                    help="pointer-score impl: XLA (materialized intermediate) "
                         "or the fused Pallas kernel")
+    p.add_argument("--typed-edges", action="store_true",
+                   help="learn one gain per edge family instead of the "
+                        "reference's flattened untyped adjacency "
+                        "(beyond-parity extension; identical at init)")
     p.add_argument("--seq-shards", type=int, default=None, metavar="N",
                    help="ring-attention sequence parallelism: shard decoder "
                         "cross-attention K/V over N devices (long-context "
@@ -98,6 +102,8 @@ def _resolve_cfg(args):
         overrides["copy_head_impl"] = args.copy_head
     if args.seq_shards is not None:
         overrides["seq_shards"] = args.seq_shards
+    if args.typed_edges:
+        overrides["typed_edges"] = True
     return cfg.replace(**overrides) if overrides else cfg
 
 

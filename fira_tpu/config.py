@@ -75,6 +75,14 @@ class FiraConfig:
                                          # (run_model.py:271,305); False => log-space
     beam_kv_cache: bool = True  # O(T) cached decode vs full-prefix re-decode
 
+    # --- typed edges (beyond-parity extension) ---
+    # The reference computes six edge families then flattens them into one
+    # untyped adjacency (process_edge's `kind` is dead, Dataset.py:346-357;
+    # SURVEY Appendix B). True learns one scalar gain per family
+    # (graph_build.EDGE_KIND_*) applied to the normalized edge weights;
+    # initialized to 1.0, i.e. exactly the reference graph at init.
+    typed_edges: bool = False
+
     # --- long context ---
     # >1 routes decoder cross-attention through ring attention
     # (parallel/ring.py) over a (data, seq) mesh with that many sequence

@@ -49,6 +49,10 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
     senders = np.zeros((bs, cfg.max_edges), dtype=np.int16)
     receivers = np.zeros((bs, cfg.max_edges), dtype=np.int16)
     values = np.zeros((bs, cfg.max_edges), dtype=np.float32)
+    # pad entries keep kind 0 — harmless, a pad edge's value is 0 so any
+    # gain multiplies into nothing
+    kinds = (np.zeros((bs, cfg.max_edges), dtype=np.int8)
+             if cfg.typed_edges else None)
     offsets = split.arrays["edge_offsets"]
     for row, i in enumerate(indices):
         lo, hi = offsets[i], offsets[i + 1]
@@ -58,9 +62,15 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
         senders[row, :n] = split.arrays["edge_senders"][lo:hi]
         receivers[row, :n] = split.arrays["edge_receivers"][lo:hi]
         values[row, :n] = split.arrays["edge_values"][lo:hi]
+        if kinds is not None:
+            kinds[row, :n] = split.arrays["edge_kinds"][lo:hi]
     batch["senders"] = senders
     batch["receivers"] = receivers
     batch["values"] = values
+    if kinds is not None:
+        # only shipped when the typed-edge extension is on — the flattened
+        # default keeps the reference's exact wire format
+        batch["edge_kinds"] = kinds
 
     valid = np.zeros(bs, dtype=bool)
     valid[:n_real] = True

@@ -55,6 +55,7 @@ class Example:
     senders: np.ndarray     # int32 [n_edges] (ragged)
     receivers: np.ndarray   # int32 [n_edges]
     values: np.ndarray      # float32 [n_edges]
+    kinds: np.ndarray       # int8 [n_edges] (graph_build.EDGE_KIND_*)
 
 
 def _substitute(tokens: List[str], var_map: Dict[str, str]) -> List[str]:
@@ -126,6 +127,7 @@ def process_record(record: CommitRecord, word_vocab: Vocab,
         diff_mark=as_i32(mark), ast_change=as_i32(ast_change),
         sub_token=as_i32(sub_token_ids),
         senders=adj.senders, receivers=adj.receivers, values=adj.values,
+        kinds=adj.kinds,
     )
 
 
@@ -159,6 +161,7 @@ class ProcessedSplit:
         arrays["edge_senders"] = np.concatenate([e.senders for e in examples])
         arrays["edge_receivers"] = np.concatenate([e.receivers for e in examples])
         arrays["edge_values"] = np.concatenate([e.values for e in examples])
+        arrays["edge_kinds"] = np.concatenate([e.kinds for e in examples])
         return cls(arrays)
 
     def save(self, path: str) -> None:
@@ -232,7 +235,8 @@ class FiraDataset:
             f"edit{int(self.cfg.use_edit)}_sub{int(self.cfg.use_subtoken_copy)}"
         )
         geom = f"{self.cfg.sou_len}x{self.cfg.tar_len}x{self.cfg.ast_change_len}x{self.cfg.sub_token_len}"
-        return os.path.join(self.cache_dir, f"{split}_{tag}_{geom}.npz")
+        # v2: edge_kinds added to the ragged edge storage (typed-edge opt-in)
+        return os.path.join(self.cache_dir, f"{split}_{tag}_{geom}_v2.npz")
 
     def _ensure_processed(self, corpus: Optional[Corpus]) -> None:
         missing = [s for s in self.SPLITS if not os.path.exists(self._cache_path(s))]

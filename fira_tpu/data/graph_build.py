@@ -134,6 +134,21 @@ def copy_labels(
     return labels
 
 
+# Edge-family kinds, in the reference's insertion order (Dataset.py:220-275).
+# The reference COMPUTES these six families then flattens them (process_edge's
+# `kind` argument is dead, Dataset.py:346-357); kinds are retained here so the
+# opt-in typed-edge extension (cfg.typed_edges) can weight families — with all
+# weights 1 it reproduces the flattened reference graph exactly.
+EDGE_KIND_CHANGE_CODE = 0
+EDGE_KIND_CHANGE_AST = 1
+EDGE_KIND_AST_CODE = 2
+EDGE_KIND_AST_AST = 3
+EDGE_KIND_CODE_SUBTOKEN = 4
+EDGE_KIND_SEQUENTIAL = 5
+EDGE_KIND_SELF_LOOP = 6
+N_EDGE_KINDS = 7
+
+
 @dataclasses.dataclass
 class CooAdjacency:
     """Symmetric, degree-normalized adjacency as COO triplets."""
@@ -141,6 +156,8 @@ class CooAdjacency:
     senders: np.ndarray    # int32 [n_edges]
     receivers: np.ndarray  # int32 [n_edges]
     values: np.ndarray     # float32 [n_edges]
+    kinds: np.ndarray      # int8 [n_edges] (EDGE_KIND_*; first family wins
+                           # on dedup, like the reference's first-insert)
 
     @property
     def n_edges(self) -> int:
@@ -178,9 +195,10 @@ def build_adjacency(
     change_base = ast_base + n_ast
 
     pairs: List[Tuple[int, int]] = []
+    kinds: List[int] = []
     seen = set()
 
-    def add(p1: int, p2: int) -> None:
+    def add(p1: int, p2: int, kind: int) -> None:
         # process_edge (Dataset.py:346-357): both directions, dedup, weight 1.
         if not (0 <= p1 < graph_len and 0 <= p2 < graph_len):
             raise GraphBuildError(
@@ -189,34 +207,37 @@ def build_adjacency(
         if (p1, p2) not in seen:
             seen.add((p1, p2))
             pairs.append((p1, p2))
+            kinds.append(kind)
         if (p2, p1) not in seen:
             seen.add((p2, p1))
             pairs.append((p2, p1))
+            kinds.append(kind)
 
     if use_edit:
         for c, j in edge_change_code:          # Dataset.py:225-230
             p2 = j + 1
             if p2 >= sou_len:
                 continue
-            add(change_base + c, p2)
+            add(change_base + c, p2, EDGE_KIND_CHANGE_CODE)
         for c, a in edge_change_ast:           # Dataset.py:233-237
-            add(change_base + c, ast_base + a)
+            add(change_base + c, ast_base + a, EDGE_KIND_CHANGE_AST)
     for a, j in edge_ast_code:                 # Dataset.py:240-245
         p2 = j + 1
         if p2 >= sou_len:
             continue
-        add(ast_base + a, p2)
+        add(ast_base + a, p2, EDGE_KIND_AST_CODE)
     for a1, a2 in edge_ast:                    # Dataset.py:248-252
-        add(ast_base + a1, ast_base + a2)
+        add(ast_base + a1, ast_base + a2, EDGE_KIND_AST_AST)
     for j, k in edge_sub_token:                # Dataset.py:255-259
-        add(j + 1, sou_len + k)
+        add(j + 1, sou_len + k, EDGE_KIND_CODE_SUBTOKEN)
     for j in range(raw_diff_len + 2 - 1):      # Dataset.py:263-266
-        add(j, j + 1)
+        add(j, j + 1, EDGE_KIND_SEQUENTIAL)
 
     for i in range(graph_len):                 # Dataset.py:271-275
         if (i, i) in seen:
             raise GraphBuildError(f"explicit self-edge on node {i} before self-loops")
         pairs.append((i, i))
+        kinds.append(EDGE_KIND_SELF_LOOP)
 
     rows = np.fromiter((p[0] for p in pairs), dtype=np.int32, count=len(pairs))
     cols = np.fromiter((p[1] for p in pairs), dtype=np.int32, count=len(pairs))
@@ -224,6 +245,7 @@ def build_adjacency(
     deg_row = np.bincount(rows, minlength=graph_len).astype(np.float64)
     deg_col = np.bincount(cols, minlength=graph_len).astype(np.float64)
     values = 1.0 / np.sqrt(deg_row[rows]) / np.sqrt(deg_col[cols])
-    return CooAdjacency(rows, cols, values.astype(np.float32))
+    return CooAdjacency(rows, cols, values.astype(np.float32),
+                        np.asarray(kinds, dtype=np.int8))
 
 

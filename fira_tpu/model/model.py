@@ -330,11 +330,24 @@ class FiraModel(nn.Module):
         self.copy_net = CopyNet(cfg.embedding_dim, impl=cfg.copy_head_impl,
                                 dtype=self.dtype)
         self.out_fc = TorchDense(cfg.vocab_size, dtype=self.dtype)
+        if cfg.typed_edges:
+            from fira_tpu.data.graph_build import N_EDGE_KINDS
+
+            self.edge_gain = self.param(
+                "edge_gain", nn.initializers.ones, (N_EDGE_KINDS,),
+                jnp.float32)
 
     def encode(self, batch: Dict[str, jnp.ndarray], *,
                deterministic: bool = True):
         """Run the graph encoder once; returns ([diff||sub] states, mask)."""
         cfg = self.cfg
+        batch = dict(batch)
+        if cfg.typed_edges:
+            # typed-edge extension: per-family learned gain on the normalized
+            # weights; at init (all ones) this is bit-identical to the
+            # reference's flattened adjacency
+            batch["values"] = batch["values"] * self.edge_gain.astype(
+                batch["values"].dtype)[batch["edge_kinds"].astype(jnp.int32)]
         if cfg.adjacency_impl == "segment":
             adj = functools.partial(
                 coo_matvec, batch["senders"], batch["receivers"],

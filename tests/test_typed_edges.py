@@ -1,0 +1,130 @@
+"""Typed-edge extension (cfg.typed_edges): per-family learned gains over the
+six edge families the reference computes but flattens (its process_edge
+`kind` argument is dead, Dataset.py:346-357 — SURVEY Appendix B sanctions
+typed edges as the opt-in extension, not parity).
+
+Pins: (1) the builder's kind labels per family, first-family-wins on dedup;
+(2) EXACT equality with the untyped model at init (all gains 1.0); (3) the
+gains receive gradients; (4) dense and segment adjacency paths agree under
+non-trivial gains.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fira_tpu.config import fira_tiny
+from fira_tpu.data import graph_build as gb
+from fira_tpu.data.batching import make_batch
+from fira_tpu.data.synthetic import make_memory_split
+from fira_tpu.model.model import FiraModel
+from fira_tpu.train import step as step_lib
+from fira_tpu.train.state import init_state
+
+
+class TestBuilderKinds:
+    def _adj(self):
+        return gb.build_adjacency(
+            sou_len=8, sub_token_len=4, ast_change_len=6, raw_diff_len=4,
+            n_ast=3,
+            edge_change_code=[(0, 1)], edge_change_ast=[(0, 0)],
+            edge_ast_code=[(0, 1), (1, 2)], edge_ast=[(0, 1), (0, 2)],
+            edge_sub_token=[(0, 0)],
+        )
+
+    def test_every_family_labeled(self):
+        adj = self._adj()
+        assert adj.kinds.shape == adj.values.shape
+        present = set(adj.kinds.tolist())
+        assert present == {gb.EDGE_KIND_CHANGE_CODE, gb.EDGE_KIND_CHANGE_AST,
+                           gb.EDGE_KIND_AST_CODE, gb.EDGE_KIND_AST_AST,
+                           gb.EDGE_KIND_CODE_SUBTOKEN,
+                           gb.EDGE_KIND_SEQUENTIAL, gb.EDGE_KIND_SELF_LOOP}
+        # self-loops: exactly graph_len of them, at the tail
+        n_loops = int((adj.kinds == gb.EDGE_KIND_SELF_LOOP).sum())
+        assert n_loops == 8 + 4 + 6
+        assert (adj.senders[-n_loops:] == adj.receivers[-n_loops:]).all()
+
+    def test_dedup_keeps_kinds_aligned(self):
+        # Cross-family pair collisions are structurally impossible (each
+        # family connects a DISTINCT pair of index ranges: change-code,
+        # change-ast, ast-code, ast-ast, code-subtoken, code-code), so the
+        # dedup rule that matters is WITHIN a family: duplicated and
+        # reversed inputs must collapse while kinds stay array-aligned.
+        adj = gb.build_adjacency(
+            sou_len=8, sub_token_len=4, ast_change_len=6, raw_diff_len=4,
+            n_ast=3,
+            edge_change_code=[], edge_change_ast=[],
+            edge_ast_code=[],
+            edge_ast=[(0, 1), (0, 1), (1, 0)],  # dup + reversed duplicate
+            edge_sub_token=[],
+        )
+        assert adj.kinds.shape == adj.senders.shape
+        pairs = list(zip(adj.senders.tolist(), adj.receivers.tolist()))
+        assert len(pairs) == len(set(pairs))  # fully deduplicated
+        # exactly one symmetric ast-ast pair survives, kind preserved
+        ast_base = 12
+        idx = pairs.index((ast_base + 0, ast_base + 1))
+        rev = pairs.index((ast_base + 1, ast_base + 0))
+        assert adj.kinds[idx] == adj.kinds[rev] == gb.EDGE_KIND_AST_AST
+
+
+@pytest.fixture(scope="module")
+def typed_setup():
+    cfg = fira_tiny(batch_size=6)
+    cfg, split, _ = make_memory_split(cfg, 6, seed=3)
+    cfg_typed = cfg.replace(typed_edges=True)
+    batch_plain = make_batch(split, np.arange(6), cfg)
+    batch_typed = make_batch(split, np.arange(6), cfg_typed)
+    return cfg, cfg_typed, batch_plain, batch_typed
+
+
+def test_init_equals_untyped(typed_setup):
+    cfg, cfg_typed, batch_plain, batch_typed = typed_setup
+    model = FiraModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), batch_plain,
+                        deterministic=True)["params"]
+    nll_p, cnt_p = model.apply({"params": params}, batch_plain,
+                               deterministic=True)
+
+    model_t = FiraModel(cfg_typed)
+    params_t = dict(params)
+    params_t["edge_gain"] = jnp.ones(gb.N_EDGE_KINDS, jnp.float32)
+    nll_t, cnt_t = model_t.apply({"params": params_t}, batch_typed,
+                                 deterministic=True)
+    assert int(cnt_p) == int(cnt_t)
+    np.testing.assert_allclose(float(nll_p), float(nll_t), rtol=1e-6)
+
+
+def test_gains_receive_gradients(typed_setup):
+    _, cfg_typed, _, batch_typed = typed_setup
+    model = FiraModel(cfg_typed)
+    state = init_state(model, cfg_typed, batch_typed)
+    assert state.params["edge_gain"].shape == (gb.N_EDGE_KINDS,)
+    train_step = jax.jit(step_lib.make_train_step(model, cfg_typed))
+    for _ in range(3):
+        state, metrics = train_step(state, batch_typed)
+    gains = np.asarray(state.params["edge_gain"])
+    assert np.isfinite(float(metrics["loss"]))
+    assert not np.allclose(gains, 1.0), gains  # the optimizer moved them
+
+
+def test_dense_and_segment_agree_with_gains(typed_setup):
+    _, cfg_typed, _, batch_typed = typed_setup
+    model_d = FiraModel(cfg_typed)
+    params = model_d.init(jax.random.PRNGKey(1), batch_typed,
+                          deterministic=True)["params"]
+    params = dict(params)
+    params["edge_gain"] = jnp.asarray(
+        [1.5, 0.5, 2.0, 0.25, 1.0, 0.75, 1.25], jnp.float32)
+    nll_d, _ = model_d.apply({"params": params}, batch_typed,
+                             deterministic=True)
+    model_s = FiraModel(cfg_typed.replace(adjacency_impl="segment"))
+    nll_s, _ = model_s.apply({"params": params}, batch_typed,
+                             deterministic=True)
+    np.testing.assert_allclose(float(nll_d), float(nll_s),
+                               rtol=1e-5, atol=1e-5)
