@@ -160,6 +160,16 @@ def worker() -> None:
     from fira_tpu.train import step as step_lib
     from fira_tpu.train.state import init_state
 
+    # Persistent XLA compilation cache: if attempt 1 dies after compiling
+    # (tunnel stall mid-run), attempt 2 skips the multi-minute recompile.
+    cache_dir = os.environ.get("FIRA_XLA_CACHE", "/tmp/fira_xla_cache")
+    if cache_dir:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception as e:  # pragma: no cover - version-dependent knobs
+            print(f"compilation cache unavailable: {e}", file=sys.stderr)
+
     # Trigger device init FIRST (verdict r2 item 1): fail fast, before any
     # batch building, and record what we're running on.
     devs = jax.devices()
