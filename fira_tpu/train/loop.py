@@ -223,7 +223,8 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
 
     cps = meter.summary()["items_per_sec"] / n_chips
     # epochs ACTUALLY executed this call (a resumed run skips start_epoch of
-    # them) — callers validating resume legs depend on the distinction
+    # them; a checkpoint already past the target runs zero) — callers
+    # validating resume legs depend on the distinction
     return TrainResult(state=state, best_bleu=best_bleu,
-                       epochs_run=n_epochs - start_epoch,
+                       epochs_run=max(0, n_epochs - start_epoch),
                        commits_per_sec_per_chip=cps)
