@@ -84,3 +84,20 @@ def test_parse_fragment_never_crashes(tokens):
             assert 0 <= a1 < n and 0 <= a2 < n
         for a, j in side.edge_ast_code:
             assert 0 <= a < n and 0 <= j < len(tokens)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    st.lists(_WORD, min_size=1, max_size=12),
+    st.lists(_WORD, min_size=1, max_size=12),
+)
+def test_update_chunk_edges_never_crashes(old_tokens, new_tokens):
+    """Fuzz the full diff contract (parse both sides -> tree-diff ->
+    reclassify -> change-node edges): labels stay in the closed set and
+    edge indices stay in range, whatever the fragments look like."""
+    g = extract.update_chunk_edges(old_tokens, new_tokens)
+    assert set(g.change) <= {"match", "update", "move", "delete", "add"}
+    n = len(g.change)
+    for c, _ in (g.edge_change_code_old + g.edge_change_code_new
+                 + g.edge_change_ast_old + g.edge_change_ast_new):
+        assert 0 <= c < n

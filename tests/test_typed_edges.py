@@ -128,3 +128,34 @@ def test_dense_and_segment_agree_with_gains(typed_setup):
                              deterministic=True)
     np.testing.assert_allclose(float(nll_d), float(nll_s),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_extensions_compose(typed_setup):
+    """typed edges + ring attention + KV-cached beam in ONE model: the
+    three beyond-parity extensions must not interfere."""
+    import jax as _jax
+
+    if len(_jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from fira_tpu.decode.beam import beam_search_cached
+
+    _, cfg_typed, _, batch_typed = typed_setup
+    # batch 6 is not divisible by the ring data axis (4) -> the guard must
+    # fall back to dense for attention while typed gains still apply; use
+    # a fresh divisible batch instead to exercise the real ring path
+    from fira_tpu.data.synthetic import make_memory_split
+    from fira_tpu.data.batching import make_batch as mb
+
+    cfg = cfg_typed.replace(seq_shards=2, batch_size=8)
+    cfg, split, _ = make_memory_split(cfg, 8, seed=9)
+    cfg = cfg.replace(typed_edges=True, seq_shards=2)
+    batch = mb(split, np.arange(8), cfg)
+    model = FiraModel(cfg)
+    state = init_state(model, cfg, batch)
+    train_step = jax.jit(step_lib.make_train_step(model, cfg))
+    state, metrics = train_step(state, batch)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    tokens, probs = jax.jit(
+        lambda p, b: beam_search_cached(model, p, b, cfg)
+    )(state.params, batch)
+    assert np.isfinite(np.asarray(probs)).all()
