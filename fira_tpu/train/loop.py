@@ -28,7 +28,7 @@ import jax
 import numpy as np
 
 from fira_tpu.config import FiraConfig
-from fira_tpu.data.batching import epoch_batches, make_batch
+from fira_tpu.data.batching import epoch_batches, make_batch, prefetch_to_device
 from fira_tpu.data.dataset import FiraDataset
 from fira_tpu.data.vocab import Vocab
 from fira_tpu.decode.text import cook_prediction, deanonymize, reference_words
@@ -164,12 +164,17 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
     profiling_active = False
     global_step = 0
 
+    # Double-buffered device feed: batch i+1 transfers while step i runs
+    # (with a mesh, batches land pre-sharded along the data axis).
+    batch_sh = pmesh.batch_shardings(sample, mesh) if mesh is not None else None
+
     for epoch in range(start_epoch, n_epochs):
         last_metrics = None
-        for idx, batch in enumerate(
+        for idx, (batch, n_valid) in enumerate(prefetch_to_device(
             epoch_batches(train_split, cfg, shuffle=True, seed=cfg.seed,
-                          epoch=epoch)
-        ):
+                          epoch=epoch),
+            sharding=batch_sh,
+        )):
             if (epoch >= cfg.dev_start_epoch
                     and idx % cfg.dev_every_batches == 0):
                 if last_metrics is not None:
@@ -201,7 +206,7 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                 state, metrics = train_step(state, batch)
             global_step += 1
             last_metrics = metrics
-            pending_commits += int(np.asarray(batch["valid"]).sum())
+            pending_commits += n_valid
             if idx % 10 == 0:
                 loss = float(jax.device_get(metrics["loss"]))  # blocks
                 sync_tick()

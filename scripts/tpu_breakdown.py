@@ -1,0 +1,98 @@
+"""One-off TPU diagnosis: where does the bench step time go?
+
+Measures, on the live chip:
+  1. pure-compute step time (batches device-resident, donated state)
+  2. end-to-end step time feeding numpy host batches (bench.py's mode)
+  3. raw host->device transfer time for one batch
+  4. compute-only step time at larger per-chip batch sizes
+
+Prints one JSON line per measurement. Bounded: a few minutes total.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fira_tpu.config import fira_full
+from fira_tpu.data.batching import make_batch
+from fira_tpu.data.synthetic import make_memory_split
+from fira_tpu.model.model import FiraModel
+from fira_tpu.train import step as step_lib
+from fira_tpu.train.state import init_state
+
+cache_dir = os.environ.get("FIRA_XLA_CACHE", "/tmp/fira_xla_cache")
+jax.config.update("jax_compilation_cache_dir", cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+DTYPE = os.environ.get("FIRA_BENCH_DTYPE", "bfloat16")
+N_STEPS = int(os.environ.get("FIRA_BENCH_STEPS", "20"))
+
+devs = jax.devices()
+print(json.dumps({"probe": str(devs[0]), "kind": devs[0].device_kind}), flush=True)
+
+
+def bench_batch(batch_size: int, on_device: bool, tag: str) -> None:
+    cfg = fira_full(batch_size=batch_size, compute_dtype=DTYPE)
+    n_data = 512
+    cfg, split, _ = make_memory_split(cfg, n_data, seed=0,
+                                      pad_vocab_to=24650, pad_ast_vocab_to=71)
+    rng = np.random.RandomState(0)
+    host_batches = [
+        make_batch(split, rng.choice(n_data, batch_size, replace=True), cfg)
+        for _ in range(4)
+    ]
+    nbytes = sum(np.asarray(v).nbytes for v in jax.tree_util.tree_leaves(host_batches[0]))
+
+    model = FiraModel(cfg, dtype=jnp.dtype(DTYPE))
+    state = init_state(model, cfg, host_batches[0])
+    train_step = jax.jit(step_lib.make_train_step(model, cfg),
+                         donate_argnums=(0,)
+                         ).lower(state, host_batches[0]).compile()
+
+    # raw transfer time for one batch (fresh each iter to defeat caching)
+    t0 = time.perf_counter()
+    for b in host_batches:
+        dev_b = jax.device_put(b)
+        jax.block_until_ready(dev_b)
+    t_put = (time.perf_counter() - t0) / len(host_batches)
+
+    batches = host_batches
+    if on_device:
+        batches = [jax.device_put(b) for b in host_batches]
+        jax.block_until_ready(batches)
+
+    # warmup
+    state, metrics = train_step(state, batches[0])
+    jax.block_until_ready(metrics["loss"])
+
+    times = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        for i in range(N_STEPS):
+            state, metrics = train_step(state, batches[i % len(batches)])
+        jax.block_until_ready(metrics["loss"])
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[len(times) // 2] / N_STEPS
+    print(json.dumps({
+        "tag": tag, "batch_size": batch_size, "on_device": on_device,
+        "step_time_s": round(dt, 5),
+        "commits_per_sec": round(batch_size / dt, 1),
+        "batch_mbytes": round(nbytes / 1e6, 2),
+        "h2d_put_s": round(t_put, 5),
+    }), flush=True)
+
+
+bench_batch(170, on_device=True, tag="compute_only_170")
+bench_batch(170, on_device=False, tag="end_to_end_170")
+bench_batch(340, on_device=True, tag="compute_only_340")
+bench_batch(680, on_device=True, tag="compute_only_680")
+bench_batch(680, on_device=False, tag="end_to_end_680")

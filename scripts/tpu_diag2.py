@@ -1,0 +1,84 @@
+"""Second-stage attribution: dropout-RNG cost and batch scaling, honest sync."""
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fira_tpu.config import fira_full
+from fira_tpu.data.batching import make_batch
+from fira_tpu.data.synthetic import make_memory_split
+from fira_tpu.model.model import FiraModel
+from fira_tpu.train import step as step_lib
+from fira_tpu.train.state import TrainState, init_state, make_optimizer
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/fira_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+N = 10
+
+
+def make_det_step(model, cfg):
+    optimizer = make_optimizer(cfg)
+
+    def loss_fn(params, batch):
+        nll_sum, count = model.apply({"params": params}, batch,
+                                     deterministic=True)
+        return nll_sum / jnp.maximum(count, 1)
+
+    def det_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p + u).astype(p.dtype), state.params, updates)
+        return TrainState(step=state.step + 1, params=params,
+                          opt_state=opt_state, rng=state.rng), {"loss": loss}
+
+    return det_step
+
+
+def measure(tag, batch_size=170, det=False):
+    cfg = fira_full(batch_size=batch_size, compute_dtype="bfloat16")
+    cfg, split, _ = make_memory_split(cfg, 256, seed=0,
+                                      pad_vocab_to=24650, pad_ast_vocab_to=71)
+    rng = np.random.RandomState(0)
+    host = [make_batch(split, rng.choice(256, batch_size, replace=True), cfg)
+            for _ in range(4)]
+    model = FiraModel(cfg, dtype=jnp.bfloat16)
+    state = init_state(model, cfg, host[0])
+    fn = make_det_step(model, cfg) if det else step_lib.make_train_step(model, cfg)
+    step = jax.jit(fn, donate_argnums=(0,))
+    dev = jax.device_put(host)
+    jax.block_until_ready(dev)
+
+    t0 = time.perf_counter()
+    state, m = step(state, dev[0])
+    _ = float(m["loss"])
+    compile_s = time.perf_counter() - t0
+    for i in range(N):  # saturation throwaway
+        state, m = step(state, dev[i % 4])
+    _ = float(m["loss"])
+    times = []
+    for _w in range(3):
+        t0 = time.perf_counter()
+        for i in range(N):
+            state, m = step(state, dev[i % 4])
+        _ = float(m["loss"])
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[1] / N
+    print(json.dumps({"tag": tag, "step_ms": round(dt * 1e3, 2),
+                      "commits_per_sec": round(batch_size / dt, 1),
+                      "compile_s": round(compile_s, 1)}), flush=True)
+
+
+measure("det_nodropout", det=True)
+measure("batch340", batch_size=340)
+measure("batch680", batch_size=680)

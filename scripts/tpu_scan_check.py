@@ -1,0 +1,56 @@
+"""Is the ~103 ms/step honest timing chip compute or per-step dispatch
+latency?  Runs K train steps in ONE dispatch via make_multi_step (lax.scan)
+and times with forced D2H materialization."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fira_tpu.config import fira_full
+from fira_tpu.data.batching import make_batch
+from fira_tpu.data.synthetic import make_memory_split
+from fira_tpu.model.model import FiraModel
+from fira_tpu.train import step as step_lib
+from fira_tpu.train.state import init_state
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/fira_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+K = int(os.environ.get("K", "8"))
+cfg = fira_full(batch_size=170, compute_dtype="bfloat16")
+cfg, split, _ = make_memory_split(cfg, 512, seed=0,
+                                  pad_vocab_to=24650, pad_ast_vocab_to=71)
+rng = np.random.RandomState(0)
+host = [make_batch(split, rng.choice(512, 170, replace=True), cfg)
+        for _ in range(K)]
+stacked = step_lib.stack_batches(host)
+model = FiraModel(cfg, dtype=jnp.bfloat16)
+state = init_state(model, cfg, host[0])
+multi = jax.jit(step_lib.make_multi_step(model, cfg),
+                donate_argnums=(0,))
+
+dev_stacked = jax.device_put(stacked)
+jax.block_until_ready(dev_stacked)
+
+t0 = time.perf_counter()
+state, m = multi(state, dev_stacked)
+losses0 = np.asarray(m["loss"])  # forces completion; includes compile
+print(json.dumps({"phase": "compile+first", "secs": round(time.perf_counter() - t0, 2),
+                  "loss0": float(losses0[0])}), flush=True)
+
+for w in range(4):
+    t0 = time.perf_counter()
+    state, m = multi(state, dev_stacked)
+    losses = np.asarray(m["loss"])  # D2H sync, cannot be faked
+    dt = time.perf_counter() - t0
+    print(json.dumps({"window": w, "k": K,
+                      "step_ms": round(dt / K * 1e3, 3),
+                      "dispatch_ms": round(dt * 1e3, 1),
+                      "finite": bool(np.isfinite(losses).all())}), flush=True)

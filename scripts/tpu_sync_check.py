@@ -1,0 +1,66 @@
+"""Does block_until_ready actually wait on the axon tunnel?
+
+Times the same 20-step device-resident window three ways:
+  block  - jax.block_until_ready(loss of last step)
+  float  - float(loss of last step)  (D2H materialization, cannot be faked)
+  chain  - float(sum of every step's loss)  (forces ALL steps' results)
+
+If `float`/`chain` >> `block`, block_until_ready returns early on this
+backend and every block-based timing is optimistic.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fira_tpu.config import fira_full
+from fira_tpu.data.batching import make_batch
+from fira_tpu.data.synthetic import make_memory_split
+from fira_tpu.model.model import FiraModel
+from fira_tpu.train import step as step_lib
+from fira_tpu.train.state import init_state
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/fira_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+N = 20
+cfg = fira_full(batch_size=170, compute_dtype="bfloat16")
+cfg, split, _ = make_memory_split(cfg, 512, seed=0,
+                                  pad_vocab_to=24650, pad_ast_vocab_to=71)
+rng = np.random.RandomState(0)
+host_batches = [make_batch(split, rng.choice(512, 170, replace=True), cfg)
+                for _ in range(4)]
+model = FiraModel(cfg, dtype=jnp.bfloat16)
+state = init_state(model, cfg, host_batches[0])
+train_step = jax.jit(step_lib.make_train_step(model, cfg),
+                     donate_argnums=(0,)).lower(state, host_batches[0]).compile()
+dev = jax.device_put(host_batches)
+jax.block_until_ready(dev)
+
+state, m = train_step(state, dev[0])
+jax.block_until_ready(m["loss"])
+
+for mode in ("block", "float", "chain", "block", "float", "chain"):
+    losses = []
+    t0 = time.perf_counter()
+    for i in range(N):
+        state, m = train_step(state, dev[i % 4])
+        if mode == "chain":
+            losses.append(m["loss"])
+    if mode == "block":
+        jax.block_until_ready(m["loss"])
+        val = None
+    elif mode == "float":
+        val = float(m["loss"])
+    else:
+        val = float(sum(jnp.stack(losses)))
+    dt = time.perf_counter() - t0
+    print(json.dumps({"mode": mode, "step_ms": round(dt / N * 1e3, 3),
+                      "val": val}), flush=True)

@@ -257,6 +257,67 @@ def test_kv_cached_beam_matches_full_redecode(tiny_setup, tiny_model_state):
                                    rtol=2e-5, atol=1e-7)
 
 
+def test_prefetch_to_device_matches_direct_feed(tiny_setup, tiny_model_state):
+    """The double-buffered input pipeline must be semantics-free: same
+    batches in the same order, host-computed n_valid, and step losses
+    identical to feeding the numpy batches directly."""
+    from fira_tpu.data.batching import epoch_batches, prefetch_to_device
+
+    dataset = tiny_setup
+    cfg = dataset.cfg
+    model, state, _ = tiny_model_state
+    split = dataset.splits["train"]
+
+    direct = list(epoch_batches(split, cfg, shuffle=True, seed=3, epoch=1))
+    pre = list(prefetch_to_device(
+        epoch_batches(split, cfg, shuffle=True, seed=3, epoch=1)))
+    assert len(pre) == len(direct)
+    for (dev_b, n_valid), host_b in zip(pre, direct):
+        assert n_valid == int(host_b["valid"].sum())
+        for k in host_b:
+            np.testing.assert_array_equal(np.asarray(dev_b[k]), host_b[k])
+
+    train_step = jax.jit(step_lib.make_train_step(model, cfg))
+    s1, s2 = state, state
+    for host_b, (dev_b, _) in zip(direct, pre):
+        s1, m1 = train_step(s1, host_b)
+        s2, m2 = train_step(s2, dev_b)
+        assert float(m1["loss"]) == float(m2["loss"])
+
+    # in-flight depth larger than the stream: must drain cleanly
+    one = [direct[0]]
+    assert len(list(prefetch_to_device(iter(one), size=4))) == 1
+
+
+def test_multi_step_matches_sequential_steps(tiny_setup, tiny_model_state):
+    """make_multi_step (lax.scan device loop) must be step-for-step identical
+    to dispatching make_train_step K times: same per-step losses, same final
+    params."""
+    from fira_tpu.train.step import make_multi_step, stack_batches
+
+    dataset = tiny_setup
+    cfg = dataset.cfg
+    model, state, _ = tiny_model_state
+    split = dataset.splits["train"]
+    batches = [make_batch(split, np.arange(k, k + cfg.batch_size), cfg)
+               for k in range(0, 4 * cfg.batch_size, cfg.batch_size)]
+
+    step = jax.jit(step_lib.make_train_step(model, cfg))
+    s_seq = state
+    seq_losses = []
+    for b in batches:
+        s_seq, m = step(s_seq, b)
+        seq_losses.append(float(m["loss"]))
+
+    multi = jax.jit(make_multi_step(model, cfg))
+    s_scan, m = multi(state, stack_batches(batches))
+    np.testing.assert_allclose(np.asarray(m["loss"]), seq_losses, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        jax.device_get(s_seq.params), jax.device_get(s_scan.params))
+    assert int(s_scan.step) == int(s_seq.step)
+
+
 def test_train_end_to_end_tiny(tmp_path, tiny_setup):
     """The FIRA-tiny milestone (SURVEY.md §7 step 4): train with dev gating,
     best-checkpoint save, then beam-decode the test split to an output file."""
