@@ -48,11 +48,13 @@ PCIe (~95 ms floor at 12 GB/s) plus the DataParallel scatter/gather and the
 gives 680/0.5/4 = 340 commits/sec/chip. We use 340 — the optimistic end, so
 vs_baseline understates rather than oversells the speedup.
 
-mfu: model FLOPs/step (XLA's own compiled cost analysis of the train step;
-analytic fallback if unavailable) / measured step time / chip peak FLOPs for
-the benchmark dtype.  Peak is looked up from device_kind (override with
-FIRA_TPU_PEAK_FLOPS); flops_per_step and peak_flops are reported alongside so
-the number is auditable.
+mfu: analytic model FLOPs/step (MXU terms from the model geometry — the
+numerator of record, see _analytic_flops) / compute-only step time / chip
+peak FLOPs for the benchmark dtype.  XLA's compiled cost analysis rides
+along as flops_per_step_xla (it also counts compiler-generated work, so it
+overstates model FLOPs).  Peak is looked up from device_kind (override with
+FIRA_TPU_PEAK_FLOPS); flops_per_step and peak_flops are reported alongside
+so the number is auditable.
 
 Env knobs: FIRA_BENCH_DTYPE=float32|bfloat16 (default bfloat16, the TPU fast
 path; quality parity is validated in f32 by the test suite),

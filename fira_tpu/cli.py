@@ -76,6 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ring-attention sequence parallelism: shard decoder "
                         "cross-attention K/V over N devices (long-context "
                         "scaling; 0/1 = dense attention)")
+    p.add_argument("--fused-steps", type=int, default=None, metavar="K",
+                   help="train: run K steps per dispatch as one lax.scan "
+                        "device loop (1 = per-step dispatch); dev-gate/log "
+                        "cadence rounds to K-step group boundaries")
     p.add_argument("--profile-dir", default=None,
                    help="train: write a jax.profiler trace of a steady-state "
                         "step window here (TensorBoard-loadable)")
@@ -102,6 +106,8 @@ def _resolve_cfg(args):
         overrides["copy_head_impl"] = args.copy_head
     if args.seq_shards is not None:
         overrides["seq_shards"] = args.seq_shards
+    if args.fused_steps is not None:
+        overrides["fused_steps"] = args.fused_steps
     if args.typed_edges:
         overrides["typed_edges"] = True
     return cfg.replace(**overrides) if overrides else cfg

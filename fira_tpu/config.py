@@ -83,6 +83,15 @@ class FiraConfig:
     # initialized to 1.0, i.e. exactly the reference graph at init.
     typed_edges: bool = False
 
+    # --- device loop ---
+    # >1 runs K train steps per dispatch via lax.scan over K stacked batches
+    # (train.step.make_multi_step): host/dispatch overhead drops to 1/K and
+    # the host loop can't jitter the chip. Semantics are step-identical to
+    # K single dispatches (pinned by tests); dev-gate/log/checkpoint
+    # boundaries round to group edges, exact when dev_every_batches % K == 0.
+    # Epoch-tail batches (< K) run through the per-step program.
+    fused_steps: int = 1
+
     # --- long context ---
     # >1 routes decoder cross-attention through ring attention
     # (parallel/ring.py) over a (data, seq) mesh with that many sequence

@@ -122,7 +122,9 @@ def prefetch_to_device(batches: Iterator[Batch], *, size: int = 2,
     reading it back from the device array would force a mid-epoch sync.
 
     ``sharding``: optional pytree of NamedShardings matching the batch (see
-    parallel.mesh.batch_shardings) so multi-chip feeds land pre-sharded.
+    parallel.mesh.batch_shardings) so multi-chip feeds land pre-sharded; a
+    callable ``batch -> sharding-pytree-or-None`` handles streams that mix
+    shapes (e.g. fused K-stacked groups followed by per-step tail batches).
     """
     import collections
 
@@ -130,8 +132,8 @@ def prefetch_to_device(batches: Iterator[Batch], *, size: int = 2,
 
     def put(b: Batch):
         n_valid = int(b["valid"].sum())
-        dev = jax.device_put(b, sharding) if sharding is not None \
-            else jax.device_put(b)
+        sh = sharding(b) if callable(sharding) else sharding
+        dev = jax.device_put(b, sh) if sh is not None else jax.device_put(b)
         return dev, n_valid
 
     buf = collections.deque()

@@ -92,6 +92,14 @@ def batch_shardings(batch, mesh: Mesh):
     )
 
 
+def stacked_batch_shardings(stacked_batch, mesh: Mesh):
+    """Shardings for a K-stacked batch (train.step.stack_batches): axis 0 is
+    the scan/step axis (replicated), axis 1 is the batch dim (data axis)."""
+    return jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P(None, DATA_AXIS)), stacked_batch
+    )
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

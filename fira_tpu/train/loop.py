@@ -94,6 +94,14 @@ def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
     return total_bleu / max(len(data), 1), "\n".join(out_lines) + "\n"
 
 
+def _materialize(x) -> None:
+    """Honest device sync: copy computed data to host. block_until_ready is
+    NOT a sync on some remote PJRT backends — it acks before execution
+    finishes (scripts/tpu_sync_check.py), which would close throughput-meter
+    intervals early and inflate commits/sec up to 20x."""
+    np.asarray(jax.device_get(x))
+
+
 @dataclasses.dataclass
 class TrainResult:
     state: TrainState
@@ -166,19 +174,57 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
 
     # Double-buffered device feed: batch i+1 transfers while step i runs
     # (with a mesh, batches land pre-sharded along the data axis).
-    batch_sh = pmesh.batch_shardings(sample, mesh) if mesh is not None else None
+    def batch_sharding(b):
+        if mesh is None:
+            return None
+        if b["valid"].ndim == 2:  # K-stacked group (fused device loop)
+            return pmesh.stacked_batch_shardings(b, mesh)
+        return pmesh.batch_shardings(b, mesh)
+
+    # Fused device loop (cfg.fused_steps > 1): full K-groups run as ONE
+    # lax.scan dispatch; the epoch tail (< K batches) uses the per-step
+    # program. Per-step profiling wants one annotation per dispatch, so
+    # --profile-dir falls back to per-step.
+    fused = max(1, int(cfg.fused_steps))
+    if fused > 1 and profile_dir:
+        log.console("fused_steps disabled under --profile-dir "
+                    "(per-step trace annotations)")
+        fused = 1
+    multi_step = None
+    if fused > 1:
+        stacked_sample = step_lib.stack_batches([sample] * fused)
+        multi_step = step_lib.jit_multi_step(model, cfg, mesh, state,
+                                             stacked_sample)
+
+    def epoch_feed(epoch: int):
+        """Yield K-stacked groups then un-stacked tail batches."""
+        it = epoch_batches(train_split, cfg, shuffle=True, seed=cfg.seed,
+                           epoch=epoch)
+        if fused == 1:
+            yield from it
+            return
+        group = []
+        for b in it:
+            group.append(b)
+            if len(group) == fused:
+                yield step_lib.stack_batches(group)
+                group = []
+        yield from group
 
     for epoch in range(start_epoch, n_epochs):
         last_metrics = None
-        for idx, (batch, n_valid) in enumerate(prefetch_to_device(
-            epoch_batches(train_split, cfg, shuffle=True, seed=cfg.seed,
-                          epoch=epoch),
-            sharding=batch_sh,
-        )):
-            if (epoch >= cfg.dev_start_epoch
-                    and idx % cfg.dev_every_batches == 0):
+        idx = 0  # batch index of the current item's first step
+        for batch, n_valid in prefetch_to_device(
+            epoch_feed(epoch), sharding=batch_sharding,
+        ):
+            stacked = batch["valid"].ndim == 2
+            k = batch["valid"].shape[0] if stacked else 1
+            # does [idx, idx+k) contain a multiple of the cadence?
+            gate_due = (-idx) % cfg.dev_every_batches < k
+            log_due = (-idx) % 10 < k
+            if epoch >= cfg.dev_start_epoch and gate_due:
                 if last_metrics is not None:
-                    jax.block_until_ready(last_metrics["loss"])
+                    _materialize(last_metrics["loss"])
                 sync_tick()
                 meter.pause()  # dev time is not train time
                 cur_bleu, dev_text = run_dev(dev_step, state.params, dataset,
@@ -194,25 +240,30 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
             if profile_window and global_step == profile_window[0]:
                 jax.profiler.start_trace(profile_dir)
                 profiling_active = True
-            if profiling_active:
+            if profiling_active:  # fused==1 here (forced above)
                 with profiling.step_annotation(global_step):
                     state, metrics = train_step(state, batch)
                 if global_step == profile_window[-1]:
-                    jax.block_until_ready(metrics["loss"])
+                    _materialize(metrics["loss"])
                     jax.profiler.stop_trace()
                     profiling_active = False
                     log.console(f"profile trace written to {profile_dir}")
+            elif stacked:
+                state, metrics = multi_step(state, batch)
             else:
                 state, metrics = train_step(state, batch)
-            global_step += 1
+            global_step += k
             last_metrics = metrics
             pending_commits += n_valid
-            if idx % 10 == 0:
-                loss = float(jax.device_get(metrics["loss"]))  # blocks
+            if log_due:
+                # blocks; a stacked dispatch reports its last step's loss
+                loss = float(np.asarray(
+                    jax.device_get(metrics["loss"])).ravel()[-1])
                 sync_tick()
                 log.console(f"epoch: {epoch} batch: {idx} loss: {loss:.4f}")
+            idx += k
         if last_metrics is not None:
-            jax.block_until_ready(last_metrics["loss"])
+            _materialize(last_metrics["loss"])
         sync_tick()
         ckpt.save_latest(state, best_bleu=best_bleu, epoch=epoch + 1)
 

@@ -109,15 +109,10 @@ def make_dev_step(model: FiraModel) -> Callable:
     return dev_step
 
 
-def jit_train_step(model: FiraModel, cfg: FiraConfig, mesh: Optional[Mesh],
-                   state: TrainState, sample_batch) -> Callable:
-    """Compile the train step; with a mesh, pin params/opt-state/batch
-    shardings so XLA lays out DP gradient psums + TP all-reduces over ICI."""
+def state_shardings(state: TrainState, mesh: Mesh) -> TrainState:
+    """NamedSharding pytree for a TrainState: params (and their Adam
+    moments) by the TP rules, scalars/PRNG replicated."""
     import optax
-
-    step = make_train_step(model, cfg)
-    if mesh is None:
-        return jax.jit(step, donate_argnums=(0,))
 
     params_sh = pmesh.params_shardings(state.params, mesh)
 
@@ -130,16 +125,45 @@ def jit_train_step(model: FiraModel, cfg: FiraConfig, mesh: Optional[Mesh],
             )
         return jax.tree_util.tree_map(lambda _: pmesh.replicated(mesh), o)
 
-    state_sh = TrainState(
+    return TrainState(
         step=pmesh.replicated(mesh),
         params=params_sh,
         opt_state=tuple(opt_component_shardings(o) for o in state.opt_state),
         rng=pmesh.replicated(mesh),
     )
+
+
+def jit_train_step(model: FiraModel, cfg: FiraConfig, mesh: Optional[Mesh],
+                   state: TrainState, sample_batch) -> Callable:
+    """Compile the train step; with a mesh, pin params/opt-state/batch
+    shardings so XLA lays out DP gradient psums + TP all-reduces over ICI."""
+    step = make_train_step(model, cfg)
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,))
+
+    state_sh = state_shardings(state, mesh)
     batch_sh = pmesh.batch_shardings(sample_batch, mesh)
     return jax.jit(
         step,
         in_shardings=(state_sh, batch_sh),
+        out_shardings=(state_sh, pmesh.replicated(mesh)),
+        donate_argnums=(0,),
+    )
+
+
+def jit_multi_step(model: FiraModel, cfg: FiraConfig, mesh: Optional[Mesh],
+                   state: TrainState, stacked_sample) -> Callable:
+    """Compile the K-step device loop; with a mesh, batches shard along
+    their SECOND axis (leading axis is the scan/step axis)."""
+    multi = make_multi_step(model, cfg)
+    if mesh is None:
+        return jax.jit(multi, donate_argnums=(0,))
+
+    state_sh = state_shardings(state, mesh)
+    stacked_sh = pmesh.stacked_batch_shardings(stacked_sample, mesh)
+    return jax.jit(
+        multi,
+        in_shardings=(state_sh, stacked_sh),
         out_shardings=(state_sh, pmesh.replicated(mesh)),
         donate_argnums=(0,),
     )
