@@ -76,6 +76,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ring-attention sequence parallelism: shard decoder "
                         "cross-attention K/V over N devices (long-context "
                         "scaling; 0/1 = dense attention)")
+    p.add_argument("--rng-impl", default=None, choices=["threefry", "rbg"],
+                   help="dropout PRNG: reproducible-everywhere threefry "
+                        "(default) or TPU-fast hardware rbg")
     p.add_argument("--fused-steps", type=int, default=None, metavar="K",
                    help="train: run K steps per dispatch as one lax.scan "
                         "device loop (1 = per-step dispatch); dev-gate/log "
@@ -108,6 +111,8 @@ def _resolve_cfg(args):
         overrides["seq_shards"] = args.seq_shards
     if args.fused_steps is not None:
         overrides["fused_steps"] = args.fused_steps
+    if args.rng_impl is not None:
+        overrides["rng_impl"] = args.rng_impl
     if args.typed_edges:
         overrides["typed_edges"] = True
     return cfg.replace(**overrides) if overrides else cfg

@@ -83,6 +83,15 @@ class FiraConfig:
     # initialized to 1.0, i.e. exactly the reference graph at init.
     typed_edges: bool = False
 
+    # --- dropout PRNG ---
+    # "threefry" (default): JAX's counter-based generator, reproducible
+    # across backends. "rbg": hardware random-bit generator — faster random
+    # bits on TPU (dropout costs ~10 ms of the measured 107 ms fira-full
+    # step, scripts/tpu_ablate.py det_nodropout). Param init is threefry
+    # either way (identical initial weights); checkpoints store the key, so
+    # a resume must use the impl it was trained with.
+    rng_impl: str = "threefry"
+
     # --- device loop ---
     # >1 runs K train steps per dispatch via lax.scan over K stacked batches
     # (train.step.make_multi_step): host/dispatch overhead drops to 1/K and

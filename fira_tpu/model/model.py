@@ -354,10 +354,15 @@ class FiraModel(nn.Module):
                 batch["values"],
             )
         elif cfg.adjacency_impl == "dense":
+            # scatter-accumulate in f32 (edge weights as shipped), then cast
+            # to the compute dtype ONCE here rather than inside each GCN
+            # round: same numbers (each round cast the same f32 array), but
+            # the (B, N, N) buffer the 6 rounds + backward hold is half the
+            # bytes in bf16 and no recast traffic is left for XLA to CSE
             adj = dense_adjacency(
                 batch["senders"], batch["receivers"], batch["values"],
                 cfg.graph_len,
-            )
+            ).astype(self.dtype)
         else:
             raise ValueError(
                 f"adjacency_impl={cfg.adjacency_impl!r} not in "

@@ -142,7 +142,7 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
     ckpt = CheckpointManager(ckpt_dir or os.path.join(out_dir, "ckpt"))
     best_bleu, start_epoch = 0.0, 0
     if resume and ckpt.has(CheckpointManager.LATEST):
-        state, meta = ckpt.restore_latest(state)
+        state, meta = ckpt.restore_latest(state, expect_rng_impl=cfg.rng_impl)
         best_bleu, start_epoch = meta["best_bleu"], meta["epoch"]
         log.console(f"resumed at epoch {start_epoch}, best dev bleu {best_bleu:.4f}")
 
@@ -265,7 +265,8 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
         if last_metrics is not None:
             _materialize(last_metrics["loss"])
         sync_tick()
-        ckpt.save_latest(state, best_bleu=best_bleu, epoch=epoch + 1)
+        ckpt.save_latest(state, best_bleu=best_bleu, epoch=epoch + 1,
+                         rng_impl=cfg.rng_impl)
 
     if profiling_active:  # run ended inside the profile window
         jax.profiler.stop_trace()

@@ -30,6 +30,11 @@ class TrainState(struct.PyTreeNode):
     rng: jax.Array
 
 
+def prng_impl_name(cfg_value: str) -> str:
+    """Map the config's generator name to JAX's registered impl name."""
+    return {"threefry": "threefry2x32"}.get(cfg_value, cfg_value)
+
+
 def make_optimizer(cfg: FiraConfig) -> optax.GradientTransformation:
     """Adam(lr=1e-4) with torch defaults (run_model.py:396): betas (0.9,
     0.999), eps 1e-8 — identical to optax defaults."""
@@ -38,8 +43,19 @@ def make_optimizer(cfg: FiraConfig) -> optax.GradientTransformation:
 
 def init_state(model: FiraModel, cfg: FiraConfig, sample_batch: Dict[str, Any],
                seed: Optional[int] = None) -> TrainState:
-    rng = jax.random.PRNGKey(cfg.seed if seed is None else seed)
-    init_rng, state_rng = jax.random.split(rng)
+    # rng_impl "rbg" swaps the dropout-stream generator for the
+    # hardware-friendly RBG one (threefry is the reproducible-everywhere
+    # default). Param INIT always uses threefry so initial weights are
+    # identical across the knob; only the dropout stream differs. A
+    # checkpoint stores the key, so resumes must keep the same impl.
+    impl = prng_impl_name(cfg.rng_impl)
+    s = cfg.seed if seed is None else seed
+    init_rng, _ = jax.random.split(jax.random.PRNGKey(s))
+    # State carries RAW key data (orbax-serializable); train_step re-wraps it
+    # with cfg.rng_impl. For threefry this is bit-identical to the historical
+    # split(PRNGKey(seed))[1] layout.
+    state_rng = jax.random.key_data(
+        jax.random.split(jax.random.key(s, impl=impl))[1])
     params = model.init(init_rng, sample_batch, deterministic=True)["params"]
     opt_state = make_optimizer(cfg).init(params)
     return TrainState(
@@ -69,10 +85,11 @@ class CheckpointManager:
         return os.path.join(self.ckpt_dir, name)
 
     def save_latest(self, state: TrainState, *, best_bleu: float,
-                    epoch: int) -> None:
+                    epoch: int, rng_impl: str = "threefry") -> None:
         payload = {
             "state": jax.device_get(state),
-            "meta": {"best_bleu": float(best_bleu), "epoch": int(epoch)},
+            "meta": {"best_bleu": float(best_bleu), "epoch": int(epoch),
+                     "rng_impl": rng_impl},
         }
         self._ckpt.save(self._path(self.LATEST), payload, force=True)
 
@@ -85,13 +102,34 @@ class CheckpointManager:
     def has(self, name: str) -> bool:
         return os.path.isdir(self._path(name))
 
-    def restore_latest(self, template_state: TrainState
+    def restore_latest(self, template_state: TrainState, *,
+                       expect_rng_impl: Optional[str] = None
                        ) -> Tuple[TrainState, Dict[str, Any]]:
-        payload = self._ckpt.restore(
-            self._path(self.LATEST),
-            item={"state": jax.device_get(template_state),
-                  "meta": {"best_bleu": 0.0, "epoch": 0}},
-        )
+        state_t = jax.device_get(template_state)
+        try:
+            payload = self._ckpt.restore(
+                self._path(self.LATEST),
+                item={"state": state_t,
+                      "meta": {"best_bleu": 0.0, "epoch": 0,
+                               "rng_impl": "threefry"}},
+            )
+        except Exception:
+            # checkpoints written before the rng_impl field
+            payload = self._ckpt.restore(
+                self._path(self.LATEST),
+                item={"state": state_t,
+                      "meta": {"best_bleu": 0.0, "epoch": 0}},
+            )
+            payload["meta"]["rng_impl"] = "threefry"
+        saved_impl = payload["meta"].get("rng_impl", "threefry")
+        if expect_rng_impl is not None and saved_impl != expect_rng_impl:
+            # fail HERE with the cause, not later with an opaque key-shape
+            # error inside the jitted step's wrap_key_data
+            raise ValueError(
+                f"checkpoint was trained with rng_impl={saved_impl!r} but "
+                f"this run is configured with rng_impl={expect_rng_impl!r}; "
+                f"resume with the matching --rng-impl or use a fresh "
+                f"checkpoint dir")
         return payload["state"], payload["meta"]
 
     def restore_best(self, template_params):

@@ -24,7 +24,7 @@ from jax.sharding import Mesh
 from fira_tpu.config import FiraConfig
 from fira_tpu.model.model import FiraModel
 from fira_tpu.parallel import mesh as pmesh
-from fira_tpu.train.state import TrainState, make_optimizer
+from fira_tpu.train.state import TrainState, make_optimizer, prng_impl_name
 
 
 def loss_fn(model: FiraModel, params, batch, dropout_rng) -> jnp.ndarray:
@@ -40,8 +40,14 @@ def make_train_step(model: FiraModel, cfg: FiraConfig
                                   Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     optimizer = make_optimizer(cfg)
 
+    rng_impl = prng_impl_name(cfg.rng_impl)
+
     def train_step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
-        step_rng, next_rng = jax.random.split(state.rng)
+        # state.rng is raw key data (checkpoint-friendly); re-wrap with the
+        # configured generator (threefry default / TPU-fast rbg)
+        key = jax.random.wrap_key_data(state.rng, impl=rng_impl)
+        step_rng, next_key = jax.random.split(key)
+        next_rng = jax.random.key_data(next_key)
         loss, grads = jax.value_and_grad(
             partial(loss_fn, model)
         )(state.params, batch, step_rng)

@@ -1,0 +1,88 @@
+"""Second ablation round (honest D2H sync): the round-3 optimization knobs.
+
+  base        current code (adjacency cast once to compute dtype)
+  rbg         cfg.rng_impl="rbg" hardware dropout PRNG
+  fused8      cfg.fused_steps=8 device loop (one dispatch per 8 steps)
+  rbg_fused8  both
+
+Baseline to compare against: 106.87 ms/step (pre-optimization base,
+BENCH_ATTEMPTS_r03.json attempt 7).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fira_tpu.config import fira_full
+from fira_tpu.data.batching import make_batch
+from fira_tpu.data.synthetic import make_memory_split
+from fira_tpu.model.model import FiraModel
+from fira_tpu.train import step as step_lib
+from fira_tpu.train.state import init_state
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/fira_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+N = 16
+
+
+def measure(tag, rng_impl="threefry", fused=1):
+    cfg = fira_full(batch_size=170, compute_dtype="bfloat16",
+                    rng_impl=rng_impl, fused_steps=fused)
+    cfg, split, _ = make_memory_split(cfg, 256, seed=0,
+                                      pad_vocab_to=24650, pad_ast_vocab_to=71)
+    rng = np.random.RandomState(0)
+    host = [make_batch(split, rng.choice(256, 170, replace=True), cfg)
+            for _ in range(4)]
+    model = FiraModel(cfg, dtype=jnp.bfloat16)
+    state = init_state(model, cfg, host[0])
+
+    if fused > 1:
+        stacked = step_lib.stack_batches(
+            [host[i % len(host)] for i in range(fused)])
+        run = jax.jit(step_lib.make_multi_step(model, cfg),
+                      donate_argnums=(0,))
+        dev = jax.device_put(stacked)
+        steps_per_call, calls = fused, max(1, N // fused)
+    else:
+        run = jax.jit(step_lib.make_train_step(model, cfg),
+                      donate_argnums=(0,))
+        dev = jax.device_put(host)
+        steps_per_call, calls = 1, N
+    jax.block_until_ready(dev)
+
+    def one_round():
+        nonlocal state
+        for i in range(calls):
+            b = dev if steps_per_call > 1 else dev[i % len(dev)]
+            state, m = run(state, b)
+        return float(np.asarray(jax.device_get(m["loss"])).ravel()[-1])
+
+    t0 = time.perf_counter()
+    loss = one_round()
+    compile_s = time.perf_counter() - t0
+    one_round()  # saturation throwaway
+    times = []
+    for _w in range(3):
+        t0 = time.perf_counter()
+        loss = one_round()
+        times.append(time.perf_counter() - t0)
+    n_steps = steps_per_call * calls
+    dt = sorted(times)[1] / n_steps
+    print(json.dumps({"tag": tag, "step_ms": round(dt * 1e3, 2),
+                      "commits_per_sec": round(170 / dt, 1),
+                      "loss_finite": bool(np.isfinite(loss)),
+                      "compile_s": round(compile_s, 1)}), flush=True)
+
+
+measure("base")
+measure("rbg", rng_impl="rbg")
+measure("fused8", fused=8)
+measure("rbg_fused8", rng_impl="rbg", fused=8)

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Harvest the next TPU-tunnel window: probe until the backend answers, then
+# run the queued timing experiments sequentially (each bounded), logging to
+# tpu_watchdog.log. Exits after one full harvest or ~6 h of probing.
+# Usage: nohup bash scripts/tpu_watchdog.sh >/dev/null 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+LOG=tpu_watchdog.log
+echo "[watchdog] start $(date -u +%FT%TZ)" >> "$LOG"
+for i in $(seq 1 72); do
+  if FIRA_BENCH_PROBE_TIMEOUT=60 timeout 70 python bench.py --probe >> "$LOG" 2>/dev/null; then
+    echo "[watchdog] tunnel up on probe $i $(date -u +%FT%TZ)" >> "$LOG"
+    for job in scripts/tpu_ablate2.py scripts/tpu_decode_bench.py scripts/tpu_diag3.py; do
+      echo "[watchdog] running $job $(date -u +%FT%TZ)" >> "$LOG"
+      timeout 900 python "$job" >> "$LOG" 2>&1
+      echo "[watchdog] $job rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    done
+    echo "[watchdog] running bench.py $(date -u +%FT%TZ)" >> "$LOG"
+    timeout 1200 python bench.py >> "$LOG" 2>&1
+    echo "[watchdog] bench rc=$? — harvest complete $(date -u +%FT%TZ)" >> "$LOG"
+    exit 0
+  fi
+  sleep 240
+done
+echo "[watchdog] gave up after $i probes $(date -u +%FT%TZ)" >> "$LOG"
