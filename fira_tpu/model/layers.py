@@ -73,17 +73,24 @@ def position_encoding(length: int, dmodel: int) -> np.ndarray:
     return pos
 
 
-def combination_gate(query, key, value, *, dropout=None):
+def combination_gate(query, key, value, *, dropout=None, scale=None):
     """combination_layer.py:6-17: attention-free two-channel gating.
 
     Per element: weights = softmax over the pair (q*k/sqrt(d), q*v/sqrt(d));
     output = w0*k + w1*v, then dropout. Used to fuse token vs. diff-mark
-    channels.
+    channels. ``scale`` overrides the 1/sqrt(last-dim) default — the
+    multi-head wrapper passes 1/sqrt(d_head) while keeping tensors in
+    merged (B, S, d_model) layout.
     """
-    scale = 1.0 / np.sqrt(query.shape[-1])
+    if scale is None:
+        scale = 1.0 / np.sqrt(query.shape[-1])
     qk = query * key * scale
     qv = query * value * scale
-    w = jax.nn.softmax(jnp.stack([qk, qv], axis=-1), axis=-1)
+    # pair softmax in the stable dtype like every other softmax in this
+    # file (no-op in f32; guards bf16 exp/normalize precision)
+    logits = jnp.stack([qk, qv], axis=-1)
+    w = jax.nn.softmax(logits.astype(stable_dtype(logits.dtype)),
+                       axis=-1).astype(logits.dtype)
     out = w[..., 0] * key + w[..., 1] * value
     if dropout is not None:
         out = dropout(out)
@@ -105,19 +112,29 @@ class Combination(nn.Module):
     @nn.compact
     def __call__(self, query, key, value, *, deterministic: bool):
         old_query = query
-        B = query.shape[0]
+        # the reshape-based head split used to enforce divisibility; keep
+        # the guard so a bad head count fails fast instead of silently
+        # training with a scale that matches no valid head layout
+        assert self.d_model % self.num_heads == 0, \
+            f"d_model={self.d_model} not divisible by num_heads={self.num_heads}"
         d_head = self.d_model // self.num_heads
 
-        def split_heads(x):
-            return x.reshape(B, -1, self.num_heads, d_head).transpose(0, 2, 1, 3)
-
-        q = split_heads(TorchDense(self.d_model, dtype=self.dtype, name="q_proj")(query))
-        k = split_heads(TorchDense(self.d_model, dtype=self.dtype, name="k_proj")(key))
-        v = split_heads(TorchDense(self.d_model, dtype=self.dtype, name="v_proj")(value))
+        # The gate is purely elementwise, so the reference's head
+        # split/merge transposes (gnn_transformer.py:185-198) are layout
+        # no-ops: elementwise math on (B, H, S, d_head) equals the same
+        # math on (B, S, d_model). The head count only enters through the
+        # 1/sqrt(d_head) scale, passed explicitly — bit-identical in
+        # deterministic mode (what the torch-parity tests pin); the inner
+        # dropout mask is now drawn in merged layout (same distribution,
+        # different stream). Six (B, S, d_model) transpose copies per
+        # layer saved (fwd + bwd).
+        q = TorchDense(self.d_model, dtype=self.dtype, name="q_proj")(query)
+        k = TorchDense(self.d_model, dtype=self.dtype, name="k_proj")(key)
+        v = TorchDense(self.d_model, dtype=self.dtype, name="v_proj")(value)
 
         inner_dropout = nn.Dropout(self.dropout_rate, deterministic=deterministic)
-        x = combination_gate(q, k, v, dropout=inner_dropout)
-        x = x.transpose(0, 2, 1, 3).reshape(B, -1, self.d_model)
+        x = combination_gate(q, k, v, dropout=inner_dropout,
+                             scale=1.0 / np.sqrt(d_head))
         out = TorchDense(self.d_model, dtype=self.dtype, name="out_proj")(x)
         out = nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)
         return nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype), name="norm")(out + old_query)
