@@ -1,5 +1,11 @@
 """One-off TPU diagnosis: where does the bench step time go?
 
+SUPERSEDED — uses block_until_ready timing, which this rig's backend acks
+before execution finishes (scripts/tpu_sync_check.py): the step times it
+prints are async-enqueue rates, up to 20x optimistic. Kept only because its
+h2d transfer measurements (device_put IS materializing) remain valid. Use
+tpu_ablate2.py / tpu_diag3.py for honest step timing.
+
 Measures, on the live chip:
   1. pure-compute step time (batches device-resident, donated state)
   2. end-to-end step time feeding numpy host batches (bench.py's mode)
