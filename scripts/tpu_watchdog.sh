@@ -9,7 +9,7 @@ echo "[watchdog] start $(date -u +%FT%TZ)" >> "$LOG"
 for i in $(seq 1 72); do
   if FIRA_BENCH_PROBE_TIMEOUT=60 timeout 70 python bench.py --probe >> "$LOG" 2>/dev/null; then
     echo "[watchdog] tunnel up on probe $i $(date -u +%FT%TZ)" >> "$LOG"
-    for job in scripts/tpu_ablate2.py scripts/tpu_decode_bench.py scripts/tpu_diag3.py; do
+    for job in scripts/tpu_ablate2.py scripts/tpu_profile.py scripts/tpu_decode_bench.py scripts/tpu_diag3.py; do
       echo "[watchdog] running $job $(date -u +%FT%TZ)" >> "$LOG"
       timeout 900 python "$job" >> "$LOG" 2>&1
       echo "[watchdog] $job rc=$? $(date -u +%FT%TZ)" >> "$LOG"
