@@ -30,8 +30,16 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/fira_xla_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 TRACE_DIR = os.environ.get("PROFILE_DIR", "/tmp/fira_tpu_trace")
+BATCH = int(os.environ.get("PROFILE_BATCH", "170"))
+if os.environ.get("PROFILE_CPU") == "1":
+    # CPU mode: op-relative attribution only (CPU cost model != TPU), but
+    # op NAMES match — a grossly dominant op (e.g. the adjacency scatter)
+    # shows up on either backend
+    from fira_tpu.utils.backend_guard import force_cpu_backend
 
-cfg = fira_full(batch_size=170, compute_dtype="bfloat16")
+    force_cpu_backend()
+
+cfg = fira_full(batch_size=BATCH, compute_dtype="bfloat16")
 cfg, split, _ = make_memory_split(cfg, 256, seed=0,
                                   pad_vocab_to=24650, pad_ast_vocab_to=71)
 rng = np.random.RandomState(0)

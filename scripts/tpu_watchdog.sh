@@ -11,7 +11,7 @@ for i in $(seq 1 72); do
     echo "[watchdog] tunnel up on probe $i $(date -u +%FT%TZ)" >> "$LOG"
     for job in scripts/tpu_ablate2.py scripts/tpu_profile.py scripts/tpu_decode_bench.py scripts/tpu_diag3.py; do
       echo "[watchdog] running $job $(date -u +%FT%TZ)" >> "$LOG"
-      timeout 900 python "$job" >> "$LOG" 2>&1
+      timeout 1400 python "$job" >> "$LOG" 2>&1
       echo "[watchdog] $job rc=$? $(date -u +%FT%TZ)" >> "$LOG"
     done
     echo "[watchdog] running bench.py $(date -u +%FT%TZ)" >> "$LOG"
