@@ -76,6 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ring-attention sequence parallelism: shard decoder "
                         "cross-attention K/V over N devices (long-context "
                         "scaling; 0/1 = dense attention)")
+    p.add_argument("--sort-edges", action="store_true",
+                   help="pre-sort each sample's COO edges on the host so "
+                        "the device scatter runs with sorted indices "
+                        "(semantically identical)")
     p.add_argument("--rng-impl", default=None, choices=["threefry", "rbg"],
                    help="dropout PRNG: reproducible-everywhere threefry "
                         "(default) or TPU-fast hardware rbg")
@@ -113,6 +117,8 @@ def _resolve_cfg(args):
         overrides["fused_steps"] = args.fused_steps
     if args.rng_impl is not None:
         overrides["rng_impl"] = args.rng_impl
+    if args.sort_edges:
+        overrides["sort_edges"] = True
     if args.typed_edges:
         overrides["typed_edges"] = True
     return cfg.replace(**overrides) if overrides else cfg

@@ -60,6 +60,11 @@ class FiraConfig:
     # "segment": gather/scatter message passing directly on the COO triplets —
     #   O(edges) memory, the path that scales past the 650-node geometry.
     adjacency_impl: str = "dense"
+    # Sort each sample's COO edges by (sender, receiver) on the host so the
+    # on-device scatter gets indices_are_sorted=True (XLA can lower sorted
+    # scatters without its sorting prologue). Semantically a no-op —
+    # scatter-add order is irrelevant; equality is pinned by tests.
+    sort_edges: bool = False
     # "xla": pointer scores materialize the (B,T,S,D) tanh intermediate;
     # "pallas": fused kernel streams it through VMEM (ops/copy_score.py) —
     #   same math, no HBM intermediate (runs interpreted off-TPU).

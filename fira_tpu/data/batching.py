@@ -64,6 +64,19 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
         values[row, :n] = split.arrays["edge_values"][lo:hi]
         if kinds is not None:
             kinds[row, :n] = split.arrays["edge_kinds"][lo:hi]
+    if cfg.sort_edges:
+        # row-wise sort by linear cell index -> the device scatter's index
+        # stream is globally sorted (rows ascend, cells ascend within a
+        # row); pads (0,0,value 0) land first and still add nothing
+        order = np.argsort(
+            senders.astype(np.int32) * cfg.graph_len + receivers, axis=1,
+            kind="stable")
+        senders = np.take_along_axis(senders, order, axis=1)
+        receivers = np.take_along_axis(receivers, order, axis=1)
+        values = np.take_along_axis(values, order, axis=1)
+        if kinds is not None:
+            kinds = np.take_along_axis(kinds, order, axis=1)
+
     batch["senders"] = senders
     batch["receivers"] = receivers
     batch["values"] = values

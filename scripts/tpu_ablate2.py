@@ -33,9 +33,10 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 N = 16
 
 
-def measure(tag, rng_impl="threefry", fused=1):
+def measure(tag, rng_impl="threefry", fused=1, sort_edges=False):
     cfg = fira_full(batch_size=170, compute_dtype="bfloat16",
-                    rng_impl=rng_impl, fused_steps=fused)
+                    rng_impl=rng_impl, fused_steps=fused,
+                    sort_edges=sort_edges)
     cfg, split, _ = make_memory_split(cfg, 256, seed=0,
                                       pad_vocab_to=24650, pad_ast_vocab_to=71)
     rng = np.random.RandomState(0)
@@ -84,5 +85,6 @@ def measure(tag, rng_impl="threefry", fused=1):
 
 measure("base")
 measure("rbg", rng_impl="rbg")
+measure("sorted_scatter", sort_edges=True)
 measure("fused8", fused=8)
 measure("rbg_fused8", rng_impl="rbg", fused=8)

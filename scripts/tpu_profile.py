@@ -43,7 +43,7 @@ cfg = fira_full(batch_size=BATCH, compute_dtype="bfloat16")
 cfg, split, _ = make_memory_split(cfg, 256, seed=0,
                                   pad_vocab_to=24650, pad_ast_vocab_to=71)
 rng = np.random.RandomState(0)
-host = [make_batch(split, rng.choice(256, 170, replace=True), cfg)
+host = [make_batch(split, rng.choice(256, BATCH, replace=True), cfg)
         for _ in range(2)]
 model = FiraModel(cfg, dtype=jnp.bfloat16)
 state = init_state(model, cfg, host[0])
