@@ -180,7 +180,7 @@ def worker() -> None:
     import jax
     import numpy as np
 
-    from fira_tpu.config import fira_full
+    from fira_tpu.config import get_config
     from fira_tpu.data.batching import make_batch
     from fira_tpu.data.synthetic import make_memory_split
     from fira_tpu.model.model import FiraModel
@@ -213,16 +213,24 @@ def worker() -> None:
 
     dtype = os.environ.get("FIRA_BENCH_DTYPE", "bfloat16")
     n_steps = int(os.environ.get("FIRA_BENCH_STEPS", "20"))
-    batch_size = int(os.environ.get("FIRA_BENCH_BATCH", "170"))
+    # FIRA_BENCH_CONFIG: the official number is fira-full; fira-tiny exists
+    # for the CPU harness test (tests/test_bench_harness.py) which drives
+    # the whole orchestrator->probe->worker->JSON path in seconds.
+    cfg_name = os.environ.get("FIRA_BENCH_CONFIG", "fira-full")
+    cfg0 = get_config(cfg_name)
+    batch_size = int(os.environ.get("FIRA_BENCH_BATCH",
+                                    str(cfg0.batch_size)))
 
-    cfg = fira_full(batch_size=batch_size, compute_dtype=dtype)
+    cfg = cfg0.replace(batch_size=batch_size, compute_dtype=dtype)
 
-    # synthetic corpus at full geometry; vocabs padded to the reference's
-    # 24,650 words / 71 labels so the fused 25,020-way output costs what the
-    # real run costs
-    n_data = 512
-    cfg, split, _ = make_memory_split(cfg, n_data, seed=0,
-                                      pad_vocab_to=24650, pad_ast_vocab_to=71)
+    # synthetic corpus; at the flagship geometry vocabs pad to the
+    # reference's 24,650 words / 71 labels so the fused 25,020-way output
+    # costs what the real run costs
+    n_data = int(os.environ.get("FIRA_BENCH_DATA", "512"))
+    pad_vocab = 24650 if cfg_name == "fira-full" else 0
+    cfg, split, _ = make_memory_split(
+        cfg, n_data, seed=0, pad_vocab_to=pad_vocab,
+        pad_ast_vocab_to=71 if pad_vocab else 0)
     rng = np.random.RandomState(0)
     host_batches = [
         make_batch(split, rng.choice(n_data, batch_size, replace=True), cfg)

@@ -1,0 +1,45 @@
+"""Drive bench.py's full orchestrator -> probe -> worker -> JSON contract on
+CPU at fira-tiny geometry. This is the driver's artifact generator: its
+one-JSON-line-in-every-outcome promise (VERDICT r2 item 1) gets a test, not
+just a docstring."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_bench(extra_env, timeout=420):
+    env = dict(os.environ)
+    env.update({
+        "FIRA_BENCH_ALLOW_CPU": "1",
+        "FIRA_BENCH_CONFIG": "fira-tiny",
+        "FIRA_BENCH_DTYPE": "float32",   # bf16 is emulated (slow) on CPU
+        "FIRA_BENCH_STEPS": "2",
+        "FIRA_BENCH_WINDOWS": "1",
+        "FIRA_BENCH_BATCH": "8",
+        "FIRA_BENCH_DATA": "16",
+        "FIRA_BENCH_PROBE_TIMEOUT": "120",
+        "FIRA_BENCH_WORKER_TIMEOUT": "300",
+    })
+    env.update(extra_env)
+    p = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, timeout=timeout, env=env, cwd=REPO)
+    lines = [ln for ln in p.stdout.strip().splitlines()
+             if ln.strip().startswith("{")]
+    assert lines, f"no JSON line in stdout:\n{p.stdout}\n{p.stderr}"
+    return p.returncode, json.loads(lines[-1])
+
+
+def test_bench_harness_cpu_success():
+    rc, result = _run_bench({})
+    assert rc == 0, result
+    assert result["metric"] == "train_commits_per_sec_per_chip"
+    assert result["value"] is not None and result["value"] > 0
+    assert result["platform"] == "cpu"
+    assert result["compute_step_time_s"] > 0
+    assert result["step_time_s"] > 0
+    assert result["flops_per_step"] > 0
