@@ -130,6 +130,30 @@ def test_dense_and_segment_agree_with_gains(typed_setup):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_sort_edges_keeps_kinds_aligned(typed_setup):
+    """sort_edges must permute edge_kinds with the same order as the
+    triplets — typed gains applied to a sorted batch give the same loss as
+    the unsorted batch."""
+    _, cfg_typed, _, _ = typed_setup
+    from fira_tpu.data.batching import make_batch as mb
+    from fira_tpu.data.synthetic import make_memory_split
+
+    cfg = cfg_typed.replace(batch_size=6)
+    cfg, split, _ = make_memory_split(cfg, 6, seed=11)
+    cfg = cfg.replace(typed_edges=True)
+    base = mb(split, np.arange(6), cfg)
+    srt = mb(split, np.arange(6), cfg.replace(sort_edges=True))
+    model = FiraModel(cfg)
+    state = init_state(model, cfg, base)
+
+    def det_loss(b):
+        nll, cnt = model.apply({"params": state.params}, b,
+                               deterministic=True)
+        return float(nll) / float(cnt)
+
+    assert det_loss(base) == pytest.approx(det_loss(srt), rel=1e-6)
+
+
 def test_extensions_compose(typed_setup):
     """typed edges + ring attention + KV-cached beam in ONE model: the
     three beyond-parity extensions must not interfere."""
