@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
@@ -30,7 +30,6 @@ import numpy as np
 from fira_tpu.config import FiraConfig
 from fira_tpu.data.batching import epoch_batches, make_batch, prefetch_to_device
 from fira_tpu.data.dataset import FiraDataset
-from fira_tpu.data.vocab import Vocab
 from fira_tpu.decode.text import cook_prediction, deanonymize, reference_words
 from fira_tpu.eval.dev_bleu import nltk_sentence_bleu
 from fira_tpu.model.model import FiraModel
