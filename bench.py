@@ -222,6 +222,12 @@ def worker() -> None:
                                     str(cfg0.batch_size)))
 
     cfg = cfg0.replace(batch_size=batch_size, compute_dtype=dtype)
+    # FIRA_BENCH_OVERRIDES: JSON dict of FiraConfig fields, e.g.
+    # '{"rng_impl": "rbg", "sort_edges": true}' — for measuring the
+    # optimization knobs without editing presets; echoed in the result.
+    overrides = json.loads(os.environ.get("FIRA_BENCH_OVERRIDES", "{}"))
+    if overrides:
+        cfg = cfg.replace(**overrides)
 
     # synthetic corpus; at the flagship geometry vocabs pad to the
     # reference's 24,650 words / 71 labels so the fused 25,020-way output
@@ -338,6 +344,7 @@ def worker() -> None:
         "device_kind": device_kind,
         "dtype": dtype,
         "batch_size": batch_size,
+        **({"overrides": overrides} if overrides else {}),
     }))
 
 

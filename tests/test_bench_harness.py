@@ -35,7 +35,8 @@ def _run_bench(extra_env, timeout=420):
 
 
 def test_bench_harness_cpu_success():
-    rc, result = _run_bench({})
+    rc, result = _run_bench(
+        {"FIRA_BENCH_OVERRIDES": '{"sort_edges": true}'})
     assert rc == 0, result
     assert result["metric"] == "train_commits_per_sec_per_chip"
     assert result["value"] is not None and result["value"] > 0
@@ -43,3 +44,4 @@ def test_bench_harness_cpu_success():
     assert result["compute_step_time_s"] > 0
     assert result["step_time_s"] > 0
     assert result["flops_per_step"] > 0
+    assert result["overrides"] == {"sort_edges": True}
