@@ -6,7 +6,7 @@
 cd "$(dirname "$0")/.." || exit 1
 LOG=tpu_watchdog.log
 echo "[watchdog] start $(date -u +%FT%TZ)" >> "$LOG"
-for i in $(seq 1 72); do
+for i in $(seq 1 600); do
   if FIRA_BENCH_PROBE_TIMEOUT=60 timeout 70 python bench.py --probe >> "$LOG" 2>/dev/null; then
     echo "[watchdog] tunnel up on probe $i $(date -u +%FT%TZ)" >> "$LOG"
     for job in scripts/tpu_ablate2.py scripts/tpu_profile.py scripts/tpu_decode_bench.py scripts/tpu_diag3.py; do
