@@ -87,6 +87,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="train: run K steps per dispatch as one lax.scan "
                         "device loop (1 = per-step dispatch); dev-gate/log "
                         "cadence rounds to K-step group boundaries")
+    p.add_argument("--accum-steps", type=int, default=None, metavar="A",
+                   help="train: accumulate A micro-batches into one "
+                        "optimizer step normalized over the global "
+                        "(sum, count) — A=4 with batch 170 reproduces the "
+                        "reference's 4-GPU batch-680 dynamics on one chip")
     p.add_argument("--profile-dir", default=None,
                    help="train: write a jax.profiler trace of a steady-state "
                         "step window here (TensorBoard-loadable)")
@@ -115,6 +120,8 @@ def _resolve_cfg(args):
         overrides["seq_shards"] = args.seq_shards
     if args.fused_steps is not None:
         overrides["fused_steps"] = args.fused_steps
+    if args.accum_steps is not None:
+        overrides["accum_steps"] = args.accum_steps
     if args.rng_impl is not None:
         overrides["rng_impl"] = args.rng_impl
     if args.sort_edges:

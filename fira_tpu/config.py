@@ -97,6 +97,15 @@ class FiraConfig:
     # a resume must use the impl it was trained with.
     rng_impl: str = "threefry"
 
+    # --- gradient accumulation ---
+    # >1 accumulates A micro-batches of batch_size into ONE optimizer step
+    # normalized over the global (sum, count) — the single-chip reproduction
+    # of the reference's 4-GPU DataParallel batch-680 dynamics
+    # (run_model.py:102-105; A=4, batch_size=170 matches it exactly).
+    # Mutually exclusive with fused_steps>1. Epoch tails smaller than A
+    # fall back to plain per-batch steps.
+    accum_steps: int = 1
+
     # --- device loop ---
     # >1 runs K train steps per dispatch via lax.scan over K stacked batches
     # (train.step.make_multi_step): host/dispatch overhead drops to 1/K and

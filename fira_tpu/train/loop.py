@@ -180,32 +180,43 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
             return pmesh.stacked_batch_shardings(b, mesh)
         return pmesh.batch_shardings(b, mesh)
 
-    # Fused device loop (cfg.fused_steps > 1): full K-groups run as ONE
-    # lax.scan dispatch; the epoch tail (< K batches) uses the per-step
-    # program. Per-step profiling wants one annotation per dispatch, so
-    # --profile-dir falls back to per-step.
+    # Grouped device programs — mutually exclusive:
+    #   fused_steps K   > 1: K-groups run as K steps in ONE lax.scan dispatch
+    #   accum_steps A   > 1: A-groups accumulate into ONE optimizer step
+    #                        normalized over the global (sum, count) — the
+    #                        reference's DataParallel batch-680 dynamics
+    # The epoch tail (< group size) uses the per-step program either way.
+    # Per-step profiling wants one annotation per dispatch, so --profile-dir
+    # falls back to per-step.
     fused = max(1, int(cfg.fused_steps))
-    if fused > 1 and profile_dir:
-        log.console("fused_steps disabled under --profile-dir "
+    accum = max(1, int(cfg.accum_steps))
+    if fused > 1 and accum > 1:
+        raise ValueError("fused_steps and accum_steps are mutually "
+                         "exclusive (one scans steps, one accumulates "
+                         "gradients); set at most one > 1")
+    if (fused > 1 or accum > 1) and profile_dir:
+        log.console("fused_steps/accum_steps disabled under --profile-dir "
                     "(per-step trace annotations)")
-        fused = 1
-    multi_step = None
-    if fused > 1:
-        stacked_sample = step_lib.stack_batches([sample] * fused)
-        multi_step = step_lib.jit_multi_step(model, cfg, mesh, state,
-                                             stacked_sample)
+        fused = accum = 1
+    group_size = fused if fused > 1 else accum
+    grouped_step = None
+    if group_size > 1:
+        stacked_sample = step_lib.stack_batches([sample] * group_size)
+        maker = (step_lib.jit_multi_step if fused > 1
+                 else step_lib.jit_accum_step)
+        grouped_step = maker(model, cfg, mesh, state, stacked_sample)
 
     def epoch_feed(epoch: int):
-        """Yield K-stacked groups then un-stacked tail batches."""
+        """Yield stacked groups then un-stacked tail batches."""
         it = epoch_batches(train_split, cfg, shuffle=True, seed=cfg.seed,
                            epoch=epoch)
-        if fused == 1:
+        if group_size == 1:
             yield from it
             return
         group = []
         for b in it:
             group.append(b)
-            if len(group) == fused:
+            if len(group) == group_size:
                 yield step_lib.stack_batches(group)
                 group = []
         yield from group
@@ -248,10 +259,11 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                     profiling_active = False
                     log.console(f"profile trace written to {profile_dir}")
             elif stacked:
-                state, metrics = multi_step(state, batch)
+                state, metrics = grouped_step(state, batch)
             else:
                 state, metrics = train_step(state, batch)
-            global_step += k
+            # a fused group is k steps; an accumulation group is ONE step
+            global_step += 1 if (stacked and accum > 1) else k
             last_metrics = metrics
             pending_commits += n_valid
             if log_due:

@@ -105,6 +105,66 @@ def stack_batches(batches) -> Dict[str, Any]:
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
 
 
+def make_accum_step(model: FiraModel, cfg: FiraConfig
+                    ) -> Callable[[TrainState, Dict[str, Any]],
+                                  Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """ONE optimizer step from A accumulated micro-batches (leading axis A).
+
+    Reproduces the reference's multi-GPU global-batch dynamics on a single
+    chip: DataParallel splits batch 680 over 4 GPUs and normalizes the
+    gathered (nll_sum, token_count) over the GLOBAL batch
+    (run_model.py:102-105). Counts carry no gradient, so
+    d[(Σ nll_i)/(Σ cnt_i)]/dθ = (Σ d nll_i)/(Σ cnt_i): accumulate raw
+    nll-gradients and counts over a lax.scan, divide once, then update —
+    bit-equal (up to f32 reassociation) to stepping one A·B batch, which
+    the tests pin in deterministic mode.
+
+    Each micro-batch draws its own dropout key (folded from the state key),
+    mirroring the distinct per-GPU streams of the reference.
+    """
+    optimizer = make_optimizer(cfg)
+    rng_impl = prng_impl_name(cfg.rng_impl)
+
+    def raw_nll(params, batch, rng):
+        nll_sum, count = model.apply(
+            {"params": params}, batch, deterministic=False,
+            rngs={"dropout": rng},
+        )
+        return nll_sum, count
+
+    def accum_step(state: TrainState, stacked_batch) -> Tuple[TrainState, Dict]:
+        key = jax.random.wrap_key_data(state.rng, impl=rng_impl)
+        step_key, next_key = jax.random.split(key)
+        next_rng = jax.random.key_data(next_key)
+
+        zero_g = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+
+        def body(carry, mb):
+            g_acc, nll_acc, cnt_acc, i = carry
+            sub = jax.random.fold_in(step_key, i)
+            (nll, cnt), g = jax.value_and_grad(raw_nll, has_aux=True)(
+                state.params, mb, sub)
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+            return (g_acc, nll_acc + nll, cnt_acc + cnt, i + 1), None
+
+        (g_sum, nll_sum, cnt_sum, _), _ = jax.lax.scan(
+            body, (zero_g, jnp.zeros(()), jnp.zeros(()), 0), stacked_batch)
+
+        denom = jnp.maximum(cnt_sum, 1)
+        grads = jax.tree_util.tree_map(lambda g: g / denom, g_sum)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p + u).astype(p.dtype), state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1, params=params, opt_state=opt_state,
+            rng=next_rng,
+        )
+        return new_state, {"loss": nll_sum / denom}
+
+    return accum_step
+
+
 def make_dev_step(model: FiraModel) -> Callable:
     """Teacher-forced greedy ids (Model.py:86 'dev' stage)."""
 
@@ -161,14 +221,26 @@ def jit_multi_step(model: FiraModel, cfg: FiraConfig, mesh: Optional[Mesh],
                    state: TrainState, stacked_sample) -> Callable:
     """Compile the K-step device loop; with a mesh, batches shard along
     their SECOND axis (leading axis is the scan/step axis)."""
-    multi = make_multi_step(model, cfg)
-    if mesh is None:
-        return jax.jit(multi, donate_argnums=(0,))
+    return _jit_stacked(make_multi_step(model, cfg), mesh, state,
+                        stacked_sample)
 
+
+def jit_accum_step(model: FiraModel, cfg: FiraConfig, mesh: Optional[Mesh],
+                   state: TrainState, stacked_sample) -> Callable:
+    """Compile the A-micro-batch accumulation step (same stacked layout as
+    the device loop: leading axis = micro-batch, second axis = batch/data)."""
+    return _jit_stacked(make_accum_step(model, cfg), mesh, state,
+                        stacked_sample)
+
+
+def _jit_stacked(fn: Callable, mesh: Optional[Mesh], state: TrainState,
+                 stacked_sample) -> Callable:
+    if mesh is None:
+        return jax.jit(fn, donate_argnums=(0,))
     state_sh = state_shardings(state, mesh)
     stacked_sh = pmesh.stacked_batch_shardings(stacked_sample, mesh)
     return jax.jit(
-        multi,
+        fn,
         in_shardings=(state_sh, stacked_sh),
         out_shardings=(state_sh, pmesh.replicated(mesh)),
         donate_argnums=(0,),

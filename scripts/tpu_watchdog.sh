@@ -1,7 +1,9 @@
 #!/bin/bash
 # Harvest the next TPU-tunnel window: probe until the backend answers, then
 # run the queued timing experiments sequentially (each bounded), logging to
-# tpu_watchdog.log. Exits after one full harvest or ~6 h of probing.
+# tpu_watchdog.log. Exits after one full harvest or 600 failed probes
+# (~50 h worst case — the probe budget outlives any realistic outage; kill
+# stale instances with `pkill -f tpu_watchdog.sh` before relaunching).
 # Usage: nohup bash scripts/tpu_watchdog.sh >/dev/null 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
 LOG=tpu_watchdog.log
