@@ -59,9 +59,11 @@ so the number is auditable.
 Env knobs: FIRA_BENCH_DTYPE=float32|bfloat16 (default bfloat16, the TPU fast
 path; quality parity is validated in f32 by the test suite),
 FIRA_BENCH_STEPS, FIRA_BENCH_BATCH, FIRA_BENCH_WINDOWS,
-FIRA_BENCH_PROBE_TIMEOUT (s, default 90), FIRA_BENCH_WORKER_TIMEOUT (s,
-default 1500), FIRA_BENCH_ALLOW_CPU=1 (let the worker run on CPU — for
-harness testing only; the result is flagged "platform": "cpu").
+FIRA_BENCH_PROBE_TIMEOUT (s, default 90), FIRA_BENCH_PROBE_BUDGET (s, default
+2700 — total wall-clock spent waiting for the tunnel before giving up),
+FIRA_BENCH_WORKER_TIMEOUT (s, default 1500), FIRA_BENCH_RETRY_SLEEP (s),
+FIRA_BENCH_ALLOW_CPU=1 (let the worker run on CPU — for harness testing
+only; the result is flagged "platform": "cpu").
 """
 
 from __future__ import annotations
@@ -380,45 +382,75 @@ def _last_json_line(out: str) -> dict | None:
 def orchestrate() -> None:
     probe_timeout = float(os.environ.get("FIRA_BENCH_PROBE_TIMEOUT", "90"))
     worker_timeout = float(os.environ.get("FIRA_BENCH_WORKER_TIMEOUT", "1500"))
-    backoffs = [5, 10, 20, 40]  # 5 probe attempts over ~3 min of sleep
+    # Total wall-clock the orchestrator may spend in phase 1 waiting for the
+    # tunnel. Outages on this rig last hours, not minutes — rounds 1-3's
+    # driver-captured artifacts were all null because the old fixed 5-probe
+    # schedule gave up after ~9 minutes. Default: keep probing for 45 min so
+    # a driver window that overlaps the tail of an outage still lands a
+    # number. Override with FIRA_BENCH_PROBE_BUDGET (seconds).
+    probe_budget = float(os.environ.get("FIRA_BENCH_PROBE_BUDGET", "2700"))
     attempts: list[dict] = []
+
+    def trimmed_attempts() -> list[dict]:
+        # dozens of identical timeout records add no information — keep the
+        # first 3 and last 5 plus a count so the JSON line stays bounded
+        if len(attempts) <= 8:
+            return attempts
+        return (attempts[:3]
+                + [{"phase": "probe", "omitted": len(attempts) - 8}]
+                + attempts[-5:])
 
     def fail(error: str) -> None:
         print(json.dumps({
             "metric": METRIC, "value": None, "unit": UNIT,
             "vs_baseline": None, "mfu": None,
-            "error": error, "attempts": attempts,
+            "error": error, "attempts": trimmed_attempts(),
         }))
         sys.exit(1)
 
-    # Phase 1: probe until the backend answers (a hung init is killed).
+    # Phase 1: probe until the backend answers (a hung init is killed) or
+    # the probe budget runs out.
+    deadline = time.time() + probe_budget
     probed = None
-    for i in range(len(backoffs) + 1):
+    n_probes = 0
+    fast_fails = 0  # consecutive quick nonzero exits (not tunnel hangs)
+    while True:
+        n_probes += 1
         t0 = time.time()
         rc, out, err = _run_sub("probe", probe_timeout)
-        rec = {"phase": "probe", "rc": rc, "secs": round(time.time() - t0, 1)}
+        probe_secs = time.time() - t0
+        rec = {"phase": "probe", "rc": rc, "secs": round(probe_secs, 1)}
         if rc == 0 and (probed := _last_json_line(out)):
             rec["result"] = probed
             attempts.append(rec)
             break
         rec["tail"] = (err or out).strip()[-300:]
         attempts.append(rec)
-        print(f"probe attempt {i + 1} failed "
+        print(f"probe attempt {n_probes} failed "
               f"({'timeout' if rc is None else f'rc={rc}'})", file=sys.stderr)
-        if i < len(backoffs):
-            time.sleep(backoffs[i])
-    else:
-        fail(f"backend init failed/hung on all {len(backoffs) + 1} probe "
-             f"attempts ({probe_timeout:.0f}s timeout each)")
+        # A hung probe (killed at timeout) is the tunnel being down — worth
+        # waiting out. A probe that exits nonzero in seconds, repeatedly, is
+        # a deterministic breakage (ImportError, bad env) that 45 min of
+        # retries will not fix.
+        fast_fails = fast_fails + 1 if (rc is not None and rc != 0
+                                        and probe_secs < 15.0) else 0
+        if fast_fails >= 5:
+            fail(f"probe failed fast (rc={rc}) {fast_fails} times in a row — "
+                 "deterministic failure, not a tunnel outage")
+        if time.time() + 5.0 >= deadline:
+            fail(f"backend init failed/hung on all {n_probes} probe attempts "
+                 f"over {probe_budget:.0f}s budget "
+                 f"({probe_timeout:.0f}s timeout each)")
+        time.sleep(min(60.0, deadline - time.time()))
 
     if probed.get("platform") != "tpu" \
             and os.environ.get("FIRA_BENCH_ALLOW_CPU") != "1":
         fail(f"backend answered but is not TPU: {probed}")
 
-    # Phase 2: the measurement, retried once (compile caching makes the
-    # second attempt cheaper if the first died mid-run).
+    # Phase 2: the measurement, retried twice (the persistent compile cache
+    # makes later attempts cheaper if an earlier one died mid-run).
     worker_error = None
-    for i in range(2):
+    for i in range(3):
         t0 = time.time()
         rc, out, err = _run_sub("worker", worker_timeout)
         rec = {"phase": "worker", "rc": rc, "secs": round(time.time() - t0, 1)}
@@ -441,9 +473,9 @@ def orchestrate() -> None:
               f"({'timeout' if rc is None else f'rc={rc}'})", file=sys.stderr)
         if worker_error and "no TPU backend" in worker_error:
             break  # deterministic — the platform will not change on retry
-        if i == 0:
-            time.sleep(10)
-    fail(worker_error or "worker failed on both attempts")
+        if i < 2:
+            time.sleep(float(os.environ.get("FIRA_BENCH_RETRY_SLEEP", "15")))
+    fail(worker_error or "worker failed on all attempts")
 
 
 if __name__ == "__main__":

@@ -34,6 +34,23 @@ def _run_bench(extra_env, timeout=420):
     return p.returncode, json.loads(lines[-1])
 
 
+def test_bench_harness_failure_emits_json():
+    # The worker dies deterministically (unknown config field) -> after its
+    # retries the orchestrator must still print exactly one structured JSON
+    # line with value null and the worker's own error, and exit nonzero.
+    rc, result = _run_bench({
+        "FIRA_BENCH_OVERRIDES": '{"no_such_field": 1}',
+        "FIRA_BENCH_RETRY_SLEEP": "0",
+    })
+    assert rc != 0
+    assert result["metric"] == "train_commits_per_sec_per_chip"
+    assert result["value"] is None
+    assert result["vs_baseline"] is None
+    assert result["error"]
+    assert any(a.get("phase") == "worker" for a in result["attempts"]
+               if isinstance(a, dict))
+
+
 def test_bench_harness_cpu_success():
     rc, result = _run_bench(
         {"FIRA_BENCH_OVERRIDES": '{"sort_edges": true}'})
