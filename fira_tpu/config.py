@@ -102,8 +102,9 @@ class FiraConfig:
     # normalized over the global (sum, count) — the single-chip reproduction
     # of the reference's 4-GPU DataParallel batch-680 dynamics
     # (run_model.py:102-105; A=4, batch_size=170 matches it exactly).
-    # Mutually exclusive with fused_steps>1. Epoch tails smaller than A
-    # fall back to plain per-batch steps.
+    # Mutually exclusive with fused_steps>1. Epoch tails smaller than A run
+    # as ONE accumulated step padded with all-invalid micro-batches — the
+    # same smaller-final-batch dynamics as the reference's DataLoader tail.
     accum_steps: int = 1
 
     # --- device loop ---
@@ -111,8 +112,12 @@ class FiraConfig:
     # (train.step.make_multi_step): host/dispatch overhead drops to 1/K and
     # the host loop can't jitter the chip. Semantics are step-identical to
     # K single dispatches (pinned by tests); dev-gate/log/checkpoint
-    # boundaries round to group edges, exact when dev_every_batches % K == 0.
-    # Epoch-tail batches (< K) run through the per-step program.
+    # boundaries round to group edges. NOTE the gate fires BEFORE the group
+    # with the params from before it, so best-checkpoint evaluation can be
+    # up to K-1 steps stale and multiple due gates inside one group collapse
+    # to one — pick K dividing dev_every_batches (then the only staleness is
+    # the gate-before-group ordering, same as the reference's evaluate-then-
+    # train batch loop). Epoch-tail batches (< K) run per-step.
     fused_steps: int = 1
 
     # --- long context ---

@@ -136,7 +136,7 @@ def beam_search(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
         # active beams, < own length for finished (their tail is 0-padded, and
         # they are masked out of selection anyway)
         tar_mask = flat != 0
-        tar_mask = tar_mask.at[:, 0].set(True)  # <start> may be id 0? no: 2
+        tar_mask = tar_mask.at[:, 0].set(True)  # position 0 is <start>: always attended
         fused = model.apply(
             {"params": params}, states_k, mask_k, flat, tar_mask,
             method=FiraModel.fused_probs,

@@ -28,7 +28,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 SEQ_AXIS = "seq"
@@ -139,7 +139,7 @@ def ring_attention_sharded(q, k, v, kv_mask, mesh: Mesh, *,
         body, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v, kv_mask)
 

@@ -219,7 +219,19 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
             if len(group) == group_size:
                 yield step_lib.stack_batches(group)
                 group = []
-        yield from group
+        if group and accum > 1:
+            # Accum tail: pad to the group shape with all-zero micro-batches
+            # (zero rows have label==0 everywhere, so they contribute nothing
+            # to nll_sum or token count — the same mechanism that makes
+            # make_batch's pad rows free). The tail is then ONE optimizer
+            # step normalized over the real samples' global (sum, count) —
+            # the reference DataLoader's smaller final batch, not up to A-1
+            # separate full steps.
+            pad = jax.tree_util.tree_map(np.zeros_like, group[0])
+            yield step_lib.stack_batches(
+                group + [pad] * (group_size - len(group)))
+        else:
+            yield from group
 
     for epoch in range(start_epoch, n_epochs):
         last_metrics = None
@@ -228,7 +240,11 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
             epoch_feed(epoch), sharding=batch_sharding,
         ):
             stacked = batch["valid"].ndim == 2
-            k = batch["valid"].shape[0] if stacked else 1
+            # cadence counts REAL batches: the accum tail is padded with
+            # all-zero micro-batches, so the stacked leading dim overstates
+            # it — n_valid (host-side, no sync) recovers the real count
+            # exactly because only a group's last real batch can be partial
+            k = -(-n_valid // cfg.batch_size) if stacked else 1
             # does [idx, idx+k) contain a multiple of the cadence?
             gate_due = (-idx) % cfg.dev_every_batches < k
             log_due = (-idx) % 10 < k

@@ -106,21 +106,22 @@ class CheckpointManager:
                        expect_rng_impl: Optional[str] = None
                        ) -> Tuple[TrainState, Dict[str, Any]]:
         state_t = jax.device_get(template_state)
-        try:
-            payload = self._ckpt.restore(
-                self._path(self.LATEST),
-                item={"state": state_t,
-                      "meta": {"best_bleu": 0.0, "epoch": 0,
-                               "rng_impl": "threefry"}},
-            )
-        except Exception:
-            # checkpoints written before the rng_impl field
-            payload = self._ckpt.restore(
-                self._path(self.LATEST),
-                item={"state": state_t,
-                      "meta": {"best_bleu": 0.0, "epoch": 0}},
-            )
-            payload["meta"]["rng_impl"] = "threefry"
+        # Probe the saved tree's structure to decide the restore template:
+        # checkpoints written before the rng_impl field lack meta.rng_impl,
+        # and restoring them against a template that has it raises. Probing
+        # (rather than try/restore/except Exception) keeps a transient I/O
+        # failure from being misread as "old checkpoint" and silently
+        # mislabelled threefry (advisor r3).
+        meta_t = {"best_bleu": 0.0, "epoch": 0, "rng_impl": "threefry"}
+        saved_meta_keys = (self._ckpt.metadata(self._path(self.LATEST))
+                           .item_metadata.tree.get("meta", {}))
+        if "rng_impl" not in saved_meta_keys:
+            del meta_t["rng_impl"]
+        payload = self._ckpt.restore(
+            self._path(self.LATEST),
+            item={"state": state_t, "meta": meta_t},
+        )
+        payload["meta"].setdefault("rng_impl", "threefry")
         saved_impl = payload["meta"].get("rng_impl", "threefry")
         if expect_rng_impl is not None and saved_impl != expect_rng_impl:
             # fail HERE with the cause, not later with an opaque key-shape

@@ -422,6 +422,35 @@ def test_accum_step_matches_big_batch_gradient(tiny_setup):
         jax.device_get(s_accum.params), jax.device_get(s_big.params))
 
 
+def test_accum_tail_padding_matches_plain_step(tiny_setup):
+    """The accum epoch tail is padded with all-zero micro-batches
+    (loop.epoch_feed): zero rows have label==0 everywhere, so the padded
+    group must take EXACTLY the optimizer step the plain program takes on
+    the real tail batch alone — same normalization denominator."""
+    from fira_tpu.train.step import make_accum_step, stack_batches
+
+    dataset = tiny_setup
+    cfg = dataset.cfg.replace(dropout_rate=0.0, gcn_dropout_rate=0.0)
+    split = dataset.splits["train"]
+    real = make_batch(split, np.arange(cfg.batch_size), cfg)
+    pad = jax.tree_util.tree_map(np.zeros_like, real)
+
+    model = FiraModel(cfg)
+    state = init_state(model, cfg, real)
+
+    accum = jax.jit(make_accum_step(model, cfg.replace(accum_steps=3)))
+    s_tail, m_tail = accum(state, stack_batches([real, pad, pad]))
+
+    plain = jax.jit(step_lib.make_train_step(model, cfg))
+    s_plain, m_plain = plain(state, real)
+
+    np.testing.assert_allclose(float(m_tail["loss"]), float(m_plain["loss"]),
+                               rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-5),
+        jax.device_get(s_tail.params), jax.device_get(s_plain.params))
+
+
 def test_accum_steps_training_runs_and_counts_steps(tmp_path, tiny_setup):
     """Loop integration: accum groups make ONE optimizer step each; the
     5-batch tiny epoch with A=2 yields 2 accumulated + 1 tail = 3 steps."""
