@@ -49,10 +49,10 @@ gives 680/0.5/4 = 340 commits/sec/chip. We use 340 — the optimistic end, so
 vs_baseline understates rather than oversells the speedup.
 
 mfu: analytic model FLOPs/step (MXU terms from the model geometry — the
-numerator of record, see _analytic_flops) / compute-only step time / chip
-peak FLOPs for the benchmark dtype.  XLA's compiled cost analysis rides
-along as flops_per_step_xla (it also counts compiler-generated work, so it
-overstates model FLOPs).  Peak is looked up from device_kind (override with
+numerator of record, see _analytic_flops; 2.03e12 at fira-full/170) /
+compute-only step time / chip peak FLOPs for the benchmark dtype.  XLA's
+compiled cost analysis rides along as flops_per_step_xla (it also counts
+compiler-generated work, so it slightly overstates model FLOPs).  Peak is looked up from device_kind (override with
 FIRA_TPU_PEAK_FLOPS); flops_per_step and peak_flops are reported alongside
 so the number is auditable.
 
@@ -151,30 +151,44 @@ def _flops_per_step(compiled) -> tuple[float | None, str]:
 
 
 def _analytic_flops(cfg, batch_size: int) -> float:
-    """Model-FLOPs estimate for one fwd+bwd+opt step (bwd ~= 2x fwd).
-    Counts only the MXU terms (dense projections + attention + fused output
-    head); elementwise and normalization terms are noise next to them.  This
-    is the MFU numerator of record because it is auditable from the model
-    geometry alone — MFU's definition wants the model's theoretical FLOPs,
-    whereas XLA's cost_analysis() also counts compiler-generated work
-    (scatters, remat recomputation: 2.15e12 vs 1.62e12 analytic at
-    fira-full/170), which inflates utilization.  The XLA figure is reported
-    alongside as flops_per_step_xla.  The A.x adjacency term is only MXU
-    work on the dense path; the COO path does it with segment-sums (VPU),
-    so it drops out of model FLOPs there.
+    """Model-FLOPs estimate for one fwd+bwd+opt step (bwd ~= 2x fwd for
+    param matmuls). Counts only the MXU terms (dense projections + attention
+    + fused output head); elementwise and normalization terms are noise next
+    to them. This is the MFU numerator of record because it is auditable
+    from the model geometry alone — MFU's definition wants the model's
+    theoretical FLOPs, whereas XLA's cost_analysis() also counts
+    compiler-generated work (scatters, remat recomputation), which inflates
+    utilization. At fira-full/170 this count is 2.03e12 vs XLA's 2.15e12 —
+    close, as they should be. (Round 3's 1.62e12 undercounted: it omitted
+    the Combination projections and priced decoder cross K/V at t instead
+    of s; the ~6% MFU it reported is really ~10% under the correct count.)
+    The XLA figure is reported alongside as flops_per_step_xla. The A.x
+    adjacency term is only MXU work on the dense path; the COO path does it
+    with segment-sums (VPU), so it drops out of model FLOPs there.
     """
     d = cfg.embedding_dim
     g, s, t, v = (cfg.graph_len, cfg.sou_len + cfg.sub_token_len, cfg.tar_len,
                   cfg.output_vocab_size)
     adj = g * g * d * 2 if cfg.adjacency_impl == "dense" else 0
-    enc = cfg.num_layers * (2 * g * d * d * 2 + adj)   # fc1/fc2 + A.x
-    dec = cfg.num_layers * (
-        8 * t * d * d * 2          # self+cross qkvo projections
-        + 2 * (t * t + t * s) * d * 2   # score + mix matmuls
-        + 2 * t * d * 4 * d * 2    # FFN in/out
+    enc = cfg.num_layers * (
+        4 * cfg.sou_len * d * d * 2    # Combination q/k/v/out projections
+        + 2 * g * d * d * 2            # GCN fc1/fc2
     )
-    head = t * d * v * 2 + t * s * d * 2 * 3   # fused out_fc + copy scorer
-    return 3.0 * batch_size * (enc + dec + head)
+    dec = cfg.num_layers * (
+        # self-attn q/k/v/o over t, cross-attn q/o over t, cross k/v over
+        # the s-long encoder states (NOT t — undercounting this term by s/t
+        # was how round 3 reported MFU ~6% when the true figure is ~10%)
+        (6 * t + 2 * s) * d * d * 2
+        + 2 * (t * t + t * s) * d * 2   # score + mix matmuls
+        + 2 * t * d * 4 * d * 2         # FFN in/out
+    )
+    head = (t * d * v * 2               # fused out_fc
+            + s * d * d * 2 + t * d * d * 2   # copy src/tgt projections
+            + t * s * d * 2)            # tanh-score contraction
+    # A.x backward is dx = A^T.dout only — the adjacency is batch data with
+    # no gradient — so that term runs at 2x fwd, not the 3x of param matmuls
+    return (3.0 * batch_size * (enc + dec + head)
+            + 2.0 * batch_size * cfg.num_layers * adj)
 
 
 def worker() -> None:

@@ -86,12 +86,17 @@ def combination_gate(query, key, value, *, dropout=None, scale=None):
         scale = 1.0 / np.sqrt(query.shape[-1])
     qk = query * key * scale
     qv = query * value * scale
-    # pair softmax in the stable dtype like every other softmax in this
-    # file (no-op in f32; guards bf16 exp/normalize precision)
-    logits = jnp.stack([qk, qv], axis=-1)
-    w = jax.nn.softmax(logits.astype(stable_dtype(logits.dtype)),
-                       axis=-1).astype(logits.dtype)
-    out = w[..., 0] * key + w[..., 1] * value
+    # The 2-way softmax in closed form: softmax([a, b]) = (sigmoid(a-b),
+    # sigmoid(b-a)). Same math in the same stable dtype as an explicit pair
+    # softmax (no-op in f32; guards bf16 exp precision) WITHOUT stacking a
+    # (..., 2) logits tensor — at flagship geometry that stack plus its
+    # softmax round-trips ~146 MB of f32 per encoder round, pure HBM
+    # traffic the closed form never touches.
+    sd = stable_dtype(qk.dtype)
+    diff = qk.astype(sd) - qv.astype(sd)
+    w0 = jax.nn.sigmoid(diff).astype(qk.dtype)
+    w1 = jax.nn.sigmoid(-diff).astype(qk.dtype)
+    out = w0 * key + w1 * value
     if dropout is not None:
         out = dropout(out)
     return out

@@ -41,23 +41,35 @@ from fira_tpu.ops import copy_score
 
 
 def dense_adjacency(senders, receivers, values, graph_len: int,
-                    indices_sorted: bool = False) -> jnp.ndarray:
+                    indices_sorted: bool = False,
+                    out_dtype=None) -> jnp.ndarray:
     """Scatter padded COO triplets into a dense batched adjacency.
 
     Pad entries are (0, 0, 0.0); scatter-ADD of zero is a no-op, so no
     masking is needed. Replaces the reference's host-side per-sample densify
     (Dataset.py:336-343) with one on-device scatter per step.
+    ``out_dtype``: scatter directly in the compute dtype instead of f32 —
+    bit-identical to scattering f32 then casting, because graph_build's
+    dedup guarantees each cell receives exactly one value (plus exact zero
+    pads), so no cross-edge accumulation happens in the narrow dtype; the
+    (B, N, N) buffer is built at half the bytes with no cast pass.
     ``indices_sorted``: promise that the (batch-major, cell-ascending) index
-    stream is sorted — true when cfg.sort_edges pre-sorted the batch — so
-    XLA can skip its scatter sorting prologue.
+    stream is sorted — so XLA can skip its scatter sorting prologue.
+
+    CALLER CONTRACT: pass ``indices_sorted=True`` ONLY for batches built by
+    ``data.batching.make_batch`` under ``cfg.sort_edges=True`` (it performs
+    the host-side sort this flag promises). A hand-built batch with unsorted
+    triplets under this flag produces silently undefined scatter results on
+    TPU — there is no runtime check.
     """
     B, _ = senders.shape
-    adj = jnp.zeros((B, graph_len, graph_len), dtype=values.dtype)
+    dt = values.dtype if out_dtype is None else out_dtype
+    adj = jnp.zeros((B, graph_len, graph_len), dtype=dt)
     b_idx = jnp.arange(B)[:, None]
     # indices travel int16 to halve H2D traffic; scatter wants int32
     return adj.at[b_idx, senders.astype(jnp.int32),
                   receivers.astype(jnp.int32)].add(
-        values, indices_are_sorted=indices_sorted)
+        values.astype(dt), indices_are_sorted=indices_sorted)
 
 
 def coo_matvec(senders, receivers, values, x,
@@ -68,7 +80,9 @@ def coo_matvec(senders, receivers, values, x,
     O(edges) instead of O(graph_len^2) — the message-passing path for graphs
     larger than the reference's 650 nodes. Pad edges (0,0,0.0) contribute 0.
     ``indices_sorted``: cfg.sort_edges ordered each row by (sender,
-    receiver), so the (b, s) scatter stream here is sorted too.
+    receiver), so the (b, s) scatter stream here is sorted too. Same caller
+    contract as ``dense_adjacency``: only ``make_batch``-built batches
+    satisfy the promise; violating it is silently undefined on TPU.
     """
     B = senders.shape[0]
     b_idx = jnp.arange(B)[:, None]
@@ -120,23 +134,36 @@ class Encoder(nn.Module):
         ast_change_em = embed_padded(ast_change_embed, ast_change)
         sub_token_em = embed_padded(word_embed, sub_token)
 
+        # One persistent (B, graph_len, d) node buffer for the whole stack:
+        # each round the Combination rewrites only the first sou_len rows
+        # (diff nodes fused with their marks) in place, then the GCN mixes
+        # the full graph. The reference splits the buffer into three tensors
+        # and re-concatenates every round (gnn_transformer.py:46-58) — six
+        # (B, 650, 256) relayout copies per step that a static update-slice
+        # never materializes. Same values, same parameter tree.
+        graph_em = jnp.concatenate([input_em, sub_token_em, ast_change_em],
+                                   axis=1)
         for i in range(cfg.num_layers):
+            input_em = graph_em[:, : cfg.sou_len]
             input_em = Combination(
                 num_heads=cfg.num_head, d_model=cfg.embedding_dim,
                 dropout_rate=cfg.dropout_rate, dtype=self.dtype,
                 name=f"combination_{i}",
             )(input_em, input_em, mark_em, deterministic=deterministic)
-            graph_em = jnp.concatenate([input_em, sub_token_em, ast_change_em],
-                                       axis=1)
+            # dynamic_update_slice does not promote dtypes the way the old
+            # concatenate did: round 0's buffer is the compute dtype while
+            # the Combination's post-LN output is the stable dtype — cast
+            # the update (f32/f64: no-op; bf16: affects only round 0's GCN
+            # residual precision, the fc1 input is cast either way)
+            graph_em = jax.lax.dynamic_update_slice_in_dim(
+                graph_em, input_em.astype(graph_em.dtype), 0, axis=1)
             graph_em = GCN(
                 d_model=cfg.embedding_dim, dropout_rate=cfg.gcn_dropout_rate,
                 dtype=self.dtype, name=f"gcn_{i}",
             )(graph_em, adj, deterministic=deterministic)
-            input_em = graph_em[:, : cfg.sou_len]
-            sub_token_em = graph_em[:, cfg.sou_len : cfg.sou_len + cfg.sub_token_len]
-            ast_change_em = graph_em[:, cfg.sou_len + cfg.sub_token_len :]
 
-        return input_em, sub_token_em
+        return (graph_em[:, : cfg.sou_len],
+                graph_em[:, cfg.sou_len : cfg.sou_len + cfg.sub_token_len])
 
 
 class Decoder(nn.Module):
@@ -363,15 +390,15 @@ class FiraModel(nn.Module):
                 batch["values"], indices_sorted=cfg.sort_edges,
             )
         elif cfg.adjacency_impl == "dense":
-            # scatter-accumulate in f32 (edge weights as shipped), then cast
-            # to the compute dtype ONCE here rather than inside each GCN
-            # round: same numbers (each round cast the same f32 array), but
-            # the (B, N, N) buffer the 6 rounds + backward hold is half the
-            # bytes in bf16 and no recast traffic is left for XLA to CSE
+            # scatter straight into the compute dtype: dedup guarantees one
+            # value per cell (dense_adjacency docstring), so this is
+            # bit-identical to the f32 scatter + cast it replaces while
+            # never materializing the f32 (B, N, N) buffer at all
             adj = dense_adjacency(
                 batch["senders"], batch["receivers"], batch["values"],
                 cfg.graph_len, indices_sorted=cfg.sort_edges,
-            ).astype(self.dtype)
+                out_dtype=self.dtype,
+            )
         else:
             raise ValueError(
                 f"adjacency_impl={cfg.adjacency_impl!r} not in "
@@ -446,7 +473,7 @@ class FiraModel(nn.Module):
         reference (Model.py:83-84); callers normalize (run_model.py:105)."""
         states, mask = self.encode(batch, deterministic=deterministic)
         tar = batch["msg"]
-        log_probs = self.fused_log_probs(
+        fused = self.fused_probs(
             states, mask, tar, tar != 0, deterministic=deterministic
         )
         # label = tar_label shifted left with a zero column (Model.py:71-79)
@@ -456,13 +483,24 @@ class FiraModel(nn.Module):
             axis=1,
         )
         label_mask = label != 0
-        nll = -jnp.take_along_axis(log_probs, label[..., None], axis=-1)[..., 0]
+        # Gather the label's probability FIRST, then log-clamp (Model.py:69's
+        # clip to [1e-10, 1]) — elementwise log commutes with the gather, so
+        # this is the same nll as log-clamping the whole (B, T, 25k)
+        # distribution and gathering after, without materializing that full
+        # f32 log tensor (~0.5 GB/step at flagship) in forward and backward.
+        p = jnp.take_along_axis(fused, label[..., None], axis=-1)[..., 0]
+        nll = -jnp.log(jnp.clip(p, 1e-10, 1.0))
         nll = jnp.where(label_mask, nll, 0.0)
         return nll.sum(), label_mask.sum()
 
     def dev_predict(self, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
-        """Teacher-forced greedy ids for all positions at once (Model.py:86)."""
+        """Teacher-forced greedy ids for all positions at once (Model.py:86).
+
+        argmax over the probability-space distribution: log-clamp is
+        monotonic on [1e-10, 1] and the 25k-way softmax's max is always
+        >= 1/25020 > 1e-10, so the argmax is identical to the reference's
+        argmax over the clamped log — minus a full-vocab f32 log pass."""
         states, mask = self.encode(batch, deterministic=True)
         tar = batch["msg"]
-        log_probs = self.fused_log_probs(states, mask, tar, tar != 0)
-        return jnp.argmax(log_probs, axis=-1)
+        fused = self.fused_probs(states, mask, tar, tar != 0)
+        return jnp.argmax(fused, axis=-1)

@@ -1,9 +1,16 @@
-"""Second ablation round (honest D2H sync): the round-3 optimization knobs.
+"""Second ablation round (honest D2H sync): the optimization levers.
 
-  base        current code (adjacency cast once to compute dtype)
+  base        current code — in round 4 this includes the lever set shipped
+              as default code paths (closed-form sigmoid combination gate,
+              gather-then-log loss, single-buffer encoder, direct
+              compute-dtype adjacency scatter); the delta vs the 106.87
+              ms/step round-3 base IS their combined measurement
   rbg         cfg.rng_impl="rbg" hardware dropout PRNG
+  sorted_scatter  host-sorted COO so scatters run indices_are_sorted
   fused8      cfg.fused_steps=8 device loop (one dispatch per 8 steps)
   rbg_fused8  both
+  det         dropout rates zeroed — what's left of the RNG cost
+  batch340    2x batch (per-sample cost check at the bigger tile)
 
 Baseline to compare against: 106.87 ms/step (pre-optimization base,
 BENCH_ATTEMPTS_r03.json attempt 7).
@@ -33,14 +40,15 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 N = 16
 
 
-def measure(tag, rng_impl="threefry", fused=1, sort_edges=False):
-    cfg = fira_full(batch_size=170, compute_dtype="bfloat16",
+def measure(tag, rng_impl="threefry", fused=1, sort_edges=False,
+            batch=170, **cfg_over):
+    cfg = fira_full(batch_size=batch, compute_dtype="bfloat16",
                     rng_impl=rng_impl, fused_steps=fused,
-                    sort_edges=sort_edges)
+                    sort_edges=sort_edges, **cfg_over)
     cfg, split, _ = make_memory_split(cfg, 256, seed=0,
                                       pad_vocab_to=24650, pad_ast_vocab_to=71)
     rng = np.random.RandomState(0)
-    host = [make_batch(split, rng.choice(256, 170, replace=True), cfg)
+    host = [make_batch(split, rng.choice(256, batch, replace=True), cfg)
             for _ in range(4)]
     model = FiraModel(cfg, dtype=jnp.bfloat16)
     state = init_state(model, cfg, host[0])
@@ -88,3 +96,5 @@ measure("rbg", rng_impl="rbg")
 measure("sorted_scatter", sort_edges=True)
 measure("fused8", fused=8)
 measure("rbg_fused8", rng_impl="rbg", fused=8)
+measure("det", dropout_rate=0.0, gcn_dropout_rate=0.0)
+measure("batch340", batch=340)
