@@ -32,6 +32,27 @@ _AST_LABELS = [
 ]
 _CHANGE_KINDS = ["match", "update", "move", "delete", "add"]
 
+# --- planted-signal mode (generate_corpus(signal=True)) ---
+# The quality-parity campaign needs a corpus where each ablated channel
+# carries information recoverable ONLY through that channel, so the Table-3
+# ablation ORDERING becomes a test of whether the architecture exploits the
+# channel — the mechanism the paper's ablations demonstrate — rather than a
+# coin flip on signal-free noise:
+#   edit channel: the message verb is (usually) a function of the change
+#     NODES' kind labels, which are what use_edit=False removes; the kinds
+#     are sampled independently of the diff text, so nothing else reveals
+#     them.
+#   sub-token channel: messages (usually) include a camelCase part of an
+#     identifier present in THIS commit, drawn from a pool with a rare tail
+#     — the generation path can't learn rare parts seen once, the sub-token
+#     copy pointer reads them off the diff.
+_KIND_VERB = {"delete": "removed", "add": "added", "update": "update",
+              "move": "refactor", "match": "handle"}
+_KIND_PRIORITY = ["delete", "add", "update", "move", "match"]
+# ~13.8k entries: over a 90k-commit corpus each appears only a few times,
+# so the generation softmax can't learn it but the copy pointer can read it
+_PARTS_RARE = [p + q + r for p in _PARTS for q in _PARTS for r in _PARTS]
+
 
 def _camel(rng: random.Random, n_parts: int = 2) -> Tuple[str, List[str]]:
     parts = [rng.choice(_PARTS) for _ in range(n_parts)]
@@ -43,7 +64,12 @@ def _atts_for(token: str, split_map: Dict[str, List[str]]) -> List[str]:
     return list(split_map.get(token, []))
 
 
-def generate_corpus(n_commits: int, seed: int = 0) -> Corpus:
+def generate_corpus(n_commits: int, seed: int = 0,
+                    signal: bool = False) -> Corpus:
+    """``signal=False`` (default) is byte-stable for a given seed — tests
+    and pinned artifacts depend on it. ``signal=True`` plants the
+    channel-specific message signal described above for the ablation
+    campaign; it draws extra randomness, so it is a different corpus."""
     rng = random.Random(seed)
     streams: Dict[str, list] = {
         k: [] for k in [
@@ -57,7 +83,13 @@ def generate_corpus(n_commits: int, seed: int = 0) -> Corpus:
         split_map: Dict[str, List[str]] = {}
 
         def ident(n_parts=2):
-            name, parts = _camel(rng, n_parts)
+            if signal and rng.random() < 0.25:
+                # rare-tail part: seen in ~a handful of commits corpus-wide,
+                # so only the sub-token copy pointer can reproduce it
+                parts = [rng.choice(_PARTS), rng.choice(_PARTS_RARE)]
+                name = parts[0] + parts[1].capitalize()
+            else:
+                name, parts = _camel(rng, n_parts)
             if len(parts) > 1:
                 split_map[name] = parts
             return name
@@ -138,6 +170,23 @@ def generate_corpus(n_commits: int, seed: int = 0) -> Corpus:
                     continue
             edge_change_ast.append([c, rng.randrange(n_ast)])
 
+        if signal:
+            # edit-channel plant: the verb follows the change nodes' kind
+            # labels (sampled independently of the diff text, so ONLY the
+            # change nodes — what use_edit=False removes — reveal it)
+            for kind in _KIND_PRIORITY:
+                if kind in change:
+                    if rng.random() < 0.85:
+                        msg[0] = _KIND_VERB[kind]
+                    break
+            # sub-token-channel plant: a camelCase part of an identifier in
+            # THIS commit; the rare tail makes the copy pointer the only
+            # reliable route
+            parts_pool = [p for nm in (method, old_var, new_var)
+                          for p in split_map.get(nm, [])]
+            if parts_pool and rng.random() < 0.8:
+                msg.append(rng.choice(parts_pool))
+
         streams["difftoken"].append(tokens)
         streams["diffmark"].append(marks)
         streams["diffatt"].append(diff_atts)
@@ -174,9 +223,9 @@ def build_vocabs(corpus: Corpus, min_freq: int = 1) -> Tuple[Vocab, Vocab]:
 
 
 def write_corpus_dir(data_dir: str, n_commits: int, seed: int = 0,
-                     min_freq: int = 1) -> Corpus:
+                     min_freq: int = 1, signal: bool = False) -> Corpus:
     """Generate and persist a DataSet/-layout corpus directory."""
-    corpus = generate_corpus(n_commits, seed=seed)
+    corpus = generate_corpus(n_commits, seed=seed, signal=signal)
     corpus.save(data_dir)
     word_vocab, ast_vocab = build_vocabs(corpus, min_freq=min_freq)
     import os

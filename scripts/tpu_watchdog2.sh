@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-4 tunnel watchdog: probe until the TPU answers, then
+#   1. (first window only) honest perf harvest: ablate2, per-op profile,
+#      decode bench, diag3, and a driver-style bench.py run
+#   2. the FULLSCALE v2 quality campaign (scripts/fullscale_v2.py), which is
+#      stage-resumable — repeat across windows until FULLSCALE2.json says ok
+# Exits when the campaign completes or after 600 failed probes (~40 h).
+# Usage: nohup bash scripts/tpu_watchdog2.sh >/dev/null 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+LOG=tpu_watchdog.log
+echo "[watchdog2] start $(date -u +%FT%TZ)" >> "$LOG"
+for i in $(seq 1 600); do
+  if FIRA_BENCH_PROBE_TIMEOUT=60 timeout 70 python bench.py --probe >> "$LOG" 2>/dev/null; then
+    echo "[watchdog2] tunnel up on probe $i $(date -u +%FT%TZ)" >> "$LOG"
+    if [ ! -f .watchdog_perf_done ]; then
+      for job in scripts/tpu_ablate2.py scripts/tpu_profile.py scripts/tpu_decode_bench.py scripts/tpu_large_bench.py scripts/tpu_diag3.py; do
+        echo "[watchdog2] running $job $(date -u +%FT%TZ)" >> "$LOG"
+        timeout 1400 python "$job" >> "$LOG" 2>&1
+        echo "[watchdog2] $job rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+      done
+      echo "[watchdog2] running bench.py $(date -u +%FT%TZ)" >> "$LOG"
+      FIRA_BENCH_PROBE_BUDGET=120 timeout 1200 python bench.py >> "$LOG" 2>&1
+      echo "[watchdog2] bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+      touch .watchdog_perf_done
+    fi
+    echo "[watchdog2] running fullscale_v2 $(date -u +%FT%TZ)" >> "$LOG"
+    timeout 7200 python scripts/fullscale_v2.py >> "$LOG" 2>&1
+    echo "[watchdog2] fullscale_v2 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    if python -c "import json,sys; sys.exit(0 if json.load(open('fullscale2/FULLSCALE2.json')).get('ok') else 1)" 2>/dev/null
+    then
+      echo "[watchdog2] campaign complete $(date -u +%FT%TZ)" >> "$LOG"
+      exit 0
+    fi
+  fi
+  sleep 240
+done
+echo "[watchdog2] gave up after $i probes $(date -u +%FT%TZ)" >> "$LOG"
