@@ -120,6 +120,19 @@ std::vector<Token> lex(const std::string& src) {
       i = j;
       continue;
     }
+    // Java 13+ text block: """ ... """ (may span lines; \ escapes)
+    if (c == '"' && i + 2 < n && src[i + 1] == '"' && src[i + 2] == '"') {
+      size_t j = i + 3;
+      while (j + 2 < n &&
+             !(src[j] == '"' && src[j + 1] == '"' && src[j + 2] == '"')) {
+        if (src[j] == '\\') ++j;
+        ++j;
+      }
+      if (j + 2 >= n) throw LexError("unterminated text block");
+      out.push_back({Tok::String, src.substr(i, j + 3 - i), pos});
+      i = j + 3;
+      continue;
+    }
     // string / char literal
     if (c == '"' || c == '\'') {
       size_t j = i + 1;

@@ -91,6 +91,7 @@ class Parser {
   // Python's default json.loads recursion budget.
   static constexpr int kMaxDepth = 300;
   int depth_ = 0;
+  int switch_depth_ = 0;  // yield is a statement only inside switch bodies
   struct DepthGuard {
     Parser& p;
     explicit DepthGuard(Parser& pp) : p(pp) {
@@ -232,6 +233,26 @@ class Parser {
   void parse_modifiers(std::vector<Node*>& out) {
     while (true) {
       if (at_annotation()) { out.push_back(parse_annotation()); continue; }
+      // Java 17 sealed-class modifiers are contextual identifiers: accept
+      // `sealed` when what follows keeps reading as a declaration head, and
+      // `non-sealed` by fusing its three tokens into one Modifier leaf
+      if (cur().kind == Tok::Ident && cur().text == "sealed" &&
+          (peek().kind == Tok::Keyword ||
+           (peek().kind == Tok::Op && peek().text == "@") ||
+           (peek().kind == Tok::Ident &&
+            (peek().text == "sealed" || peek().text == "non")))) {
+        out.push_back(leaf("Modifier", advance()));
+        continue;
+      }
+      if (cur().kind == Tok::Ident && cur().text == "non" &&
+          peek().kind == Tok::Op && peek().text == "-" &&
+          peek(2).kind == Tok::Ident && peek(2).text == "sealed") {
+        Token fused = cur();
+        fused.text = "non-sealed";
+        advance(); advance(); advance();
+        out.push_back(leaf("Modifier", fused));
+        continue;
+      }
       if ((cur().kind == Tok::Keyword || cur().kind == Tok::Ident) &&
           is_modifier(cur().text)) {
         // 'default' only a modifier inside interfaces; 'default:' is a switch
@@ -421,9 +442,50 @@ class Parser {
     if (at_kw("class") || at_kw("interface"))
       return parse_class_or_interface(mods, s);
     if (at_kw("enum")) return parse_enum(mods, s);
+    if (at_record()) return parse_record(mods, s);
     if (at_op("@") && peek().kind == Tok::Keyword && peek().text == "interface")
       return parse_annotation_type(mods, s);
     err("expected type declaration");
+  }
+
+  // 'record' is a contextual keyword (Java 16): a declaration only when
+  // followed by a name and its component list's '(' (or '<' type params)
+  bool at_record() const {
+    return cur().kind == Tok::Ident && cur().text == "record" &&
+           peek().kind == Tok::Ident &&
+           peek(2).kind == Tok::Op &&
+           (peek(2).text == "(" || peek(2).text == "<");
+  }
+
+  Node* parse_record(std::vector<Node*>& mods, size_t s) {
+    DepthGuard dg(*this);
+    advance();  // 'record'
+    Node* n = node("RecordDeclaration");
+    n->children = mods;
+    n->children.push_back(simple_name());
+    if (at_op("<")) parse_type_params(n->children);
+    expect_op("(");
+    while (!at_op(")")) {
+      if (at_end()) err("unterminated record components");
+      size_t ps = mark();
+      Node* p = node("SingleVariableDeclaration");
+      parse_modifiers(p->children);  // component annotations
+      p->children.push_back(parse_type());
+      p->children.push_back(simple_name());
+      finish(p, ps);
+      n->children.push_back(p);
+      if (at_op(",")) { advance(); continue; }
+      break;
+    }
+    expect_op(")");
+    if (at_kw("implements")) {
+      advance();
+      n->children.push_back(parse_type());
+      while (at_op(",")) { advance(); n->children.push_back(parse_type()); }
+    }
+    parse_class_body(n->children);
+    finish(n, s);
+    return n;
   }
 
   Node* parse_class_or_interface(std::vector<Node*>& mods, size_t s) {
@@ -439,6 +501,13 @@ class Parser {
       while (at_op(",")) { advance(); n->children.push_back(parse_type()); }
     }
     if (at_kw("implements")) {
+      advance();
+      n->children.push_back(parse_type());
+      while (at_op(",")) { advance(); n->children.push_back(parse_type()); }
+    }
+    // Java 17 permits clause (contextual keyword: only '{' may follow the
+    // heritage clauses, so a bare identifier here is unambiguous)
+    if (cur().kind == Tok::Ident && cur().text == "permits") {
       advance();
       n->children.push_back(parse_type());
       while (at_op(",")) { advance(); n->children.push_back(parse_type()); }
@@ -485,8 +554,18 @@ class Parser {
     if (at_kw("class") || at_kw("interface"))
       return parse_class_or_interface(mods, s);
     if (at_kw("enum")) return parse_enum(mods, s);
+    if (at_record()) return parse_record(mods, s);
     if (at_op("@") && peek().kind == Tok::Keyword && peek().text == "interface")
       return parse_annotation_type(mods, s);
+    // record compact constructor: Ident '{' occurs for no other member form
+    if (at_ident() && peek().kind == Tok::Op && peek().text == "{") {
+      Node* n = node("MethodDeclaration");
+      n->children = mods;
+      n->children.push_back(simple_name());
+      n->children.push_back(parse_block());
+      finish(n, s);
+      return n;
+    }
     if (at_op("{")) {  // initializer block (mods may hold 'static')
       Node* n = node("Initializer");
       n->children = mods;
@@ -819,6 +898,20 @@ class Parser {
       finish(n, s);
       return n;
     }
+    // yield statement (contextual keyword, Java 14): only inside a switch
+    // body, and not when 'yield' is being used as a plain identifier
+    // (assignment / qualifier / call / label all keep it an identifier)
+    if (switch_depth_ > 0 && at_ident() && cur().text == "yield" &&
+        !(peek().kind == Tok::Op &&
+          (peek().text == "=" || peek().text == "." || peek().text == "::" ||
+           peek().text == "[" || peek().text == "(" || peek().text == ":"))) {
+      advance();
+      Node* n = node("YieldStatement");
+      n->children.push_back(parse_expression());
+      expect_op(";");
+      finish(n, s);
+      return n;
+    }
     // labeled statement: Ident ':' stmt
     if (at_ident() && peek().kind == Tok::Op && peek().text == ":" &&
         !(peek(2).kind == Tok::Op && peek(2).text == ":")) {
@@ -967,28 +1060,59 @@ class Parser {
     expect_op("(");
     n->children.push_back(parse_expression());
     expect_op(")");
+    parse_switch_block(n);
+    finish(n, s);
+    return n;
+  }
+
+  // Shared by SwitchStatement and SwitchExpression: classic `case X:` arms,
+  // Java 14 `case A, B -> body` arms (body = expression ';' | block |
+  // throw), and yield statements (recognized inside switch bodies only).
+  void parse_switch_block(Node* n) {
     expect_op("{");
+    ++switch_depth_;
     while (!at_op("}")) {
-      if (at_end()) err("unterminated switch");
+      if (at_end()) { --switch_depth_; err("unterminated switch"); }
       if (at_kw("case") || at_kw("default")) {
         size_t cs = mark();
         Node* c = node("SwitchCase");
         if (cur().text == "case") {
           advance();
           c->children.push_back(parse_expression());
+          while (at_op(",")) {
+            advance();
+            c->children.push_back(parse_expression());
+          }
         } else {
           advance();
         }
-        expect_op(":");
-        finish(c, cs);
-        n->children.push_back(c);
+        if (at_op("->")) {
+          advance();
+          finish(c, cs);
+          n->children.push_back(c);
+          if (at_op("{")) {
+            n->children.push_back(parse_block());
+          } else if (at_kw("throw")) {
+            n->children.push_back(parse_statement());
+          } else {
+            size_t es = mark();
+            Node* st = node("ExpressionStatement");
+            st->children.push_back(parse_expression());
+            expect_op(";");
+            finish(st, es);
+            n->children.push_back(st);
+          }
+        } else {
+          expect_op(":");
+          finish(c, cs);
+          n->children.push_back(c);
+        }
       } else {
         n->children.push_back(parse_statement());
       }
     }
     advance();
-    finish(n, s);
-    return n;
+    --switch_depth_;
   }
 
   Node* parse_try(size_t s) {
@@ -1118,6 +1242,9 @@ class Parser {
         Node* n = node("InstanceofExpression");
         n->children.push_back(lhs);
         n->children.push_back(parse_type());
+        // Java 16 pattern variable: `o instanceof String s` — a bare
+        // identifier can follow the type in no other instanceof form
+        if (at_ident()) n->children.push_back(simple_name());
         finish(n, s);
         lhs = n;
         continue;
@@ -1518,6 +1645,16 @@ class Parser {
   Node* parse_primary() {
     size_t s = mark();
     if (lambda_ahead()) return parse_lambda();
+    if (at_kw("switch")) {  // Java 14 switch expression
+      advance();
+      Node* n = node("SwitchExpression");
+      expect_op("(");
+      n->children.push_back(parse_expression());
+      expect_op(")");
+      parse_switch_block(n);
+      finish(n, s);
+      return n;
+    }
     if (cur().kind == Tok::Number) return leaf("NumberLiteral", advance());
     if (cur().kind == Tok::String) return leaf("StringLiteral", advance());
     if (cur().kind == Tok::Char) return leaf("CharacterLiteral", advance());

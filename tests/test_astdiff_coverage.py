@@ -1,0 +1,39 @@
+"""Grammar-coverage regressions for the native astdiff parser.
+
+Pins every row of the stress table in scripts/astdiff_coverage.py:
+  - every JDT-3.16-era construct parses and diffs (parity with the
+    reference's vendored Eclipse JDT, get_ast_root_action.py:69-101)
+  - the post-Java-13 constructs (switch expressions, records, instanceof
+    patterns, text blocks, sealed) parse too — coverage the reference's
+    2019 JDT does NOT have
+  - contextual keywords (yield/record/sealed/permits) still work as plain
+    identifiers
+  - broken inputs return None (clean GumTree-failure degradation), never
+    crash
+"""
+
+import pytest
+
+from scripts.astdiff_coverage import (CONTEXTUAL_IDENT_CASES, DEGRADE_CASES,
+                                      JDT316_CASES, POST_JAVA13_CASES,
+                                      one_token_edit)
+
+PARSE_CASES = {**JDT316_CASES, **POST_JAVA13_CASES, **CONTEXTUAL_IDENT_CASES}
+
+
+@pytest.mark.parametrize("name", sorted(PARSE_CASES))
+def test_construct_parses_and_diffs(name):
+    from fira_tpu.preprocess.astdiff_binding import diff_lines, parse_json
+
+    src = PARSE_CASES[name]
+    tree = parse_json(src)
+    assert tree is not None, f"{name} failed to parse"
+    assert tree.get("root"), name
+    assert diff_lines(src, one_token_edit(src)), f"{name} failed to diff"
+
+
+@pytest.mark.parametrize("name", sorted(DEGRADE_CASES))
+def test_broken_input_degrades_cleanly(name):
+    from fira_tpu.preprocess.astdiff_binding import parse_json
+
+    assert parse_json(DEGRADE_CASES[name]) is None
