@@ -74,6 +74,18 @@ class FiraConfig:
     # Compute dtype for matmuls/attention. Params and the fused output
     # distribution stay float32 for parity; bf16 is the TPU fast path.
     compute_dtype: str = "float32"
+    # True (default): post-LN residual streams stay in the stable dtype
+    # (f32 under bf16 compute) between layers — the reference's f32
+    # numerics. False: LayerNorm statistics still compute in f32 but the
+    # output is cast back to the compute dtype, halving every inter-layer
+    # activation's HBM bytes under bf16. Exact no-op in f32; a measured
+    # perf knob, not a parity path.
+    stable_residual: bool = True
+    # True (default): the copy head's (B,T,S,D) tanh intermediate is
+    # rematerialized in backward (jax.checkpoint) instead of stored —
+    # ~1 GB bf16 at flagship. False stores it: ~16 GB HBM chips can afford
+    # that at batch 170, trading memory for the recompute.
+    copy_head_remat: bool = True
 
     # --- decode ---
     beam_compat_prob_space: bool = True  # reference prob-space accumulation

@@ -30,6 +30,14 @@ def stable_dtype(dtype):
     return jnp.promote_types(dtype, jnp.float32)
 
 
+def residual_out(x, residual_dtype):
+    """Post-LN output cast for the stable_residual=False perf knob: LN
+    statistics stay in the stable dtype; only the STORED residual stream is
+    narrowed (no-op when residual_dtype is None — the default f32 parity
+    numerics)."""
+    return x if residual_dtype is None else x.astype(residual_dtype)
+
+
 def torch_bias_init(key, shape, dtype, fan_in: int):
     bound = 1.0 / np.sqrt(fan_in)
     return jax.random.uniform(key, shape, dtype, -bound, bound)
@@ -113,6 +121,7 @@ class Combination(nn.Module):
     d_model: int
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
+    residual_dtype: object = None  # see residual_out
 
     @nn.compact
     def __call__(self, query, key, value, *, deterministic: bool):
@@ -142,7 +151,9 @@ class Combination(nn.Module):
                              scale=1.0 / np.sqrt(d_head))
         out = TorchDense(self.d_model, dtype=self.dtype, name="out_proj")(x)
         out = nn.Dropout(self.dropout_rate, deterministic=deterministic)(out)
-        return nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype), name="norm")(out + old_query)
+        return residual_out(
+            nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype),
+                         name="norm")(out + old_query), self.residual_dtype)
 
 
 class GCN(nn.Module):
@@ -155,6 +166,7 @@ class GCN(nn.Module):
     d_model: int
     dropout_rate: float = 0.2
     dtype: jnp.dtype = jnp.float32
+    residual_dtype: object = None  # see residual_out
 
     @nn.compact
     def __call__(self, graph_em, adj, *, deterministic: bool):
@@ -165,7 +177,9 @@ class GCN(nn.Module):
             x = jnp.einsum("bij,bjd->bid", adj.astype(self.dtype), x)
         x = TorchDense(self.d_model, dtype=self.dtype, name="fc2")(x)
         x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
-        return nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype), name="norm")(x + graph_em)
+        return residual_out(
+            nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype),
+                         name="norm")(x + graph_em), self.residual_dtype)
 
 
 class Attention(nn.Module):
@@ -185,6 +199,7 @@ class Attention(nn.Module):
     d_model: int
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
+    residual_dtype: object = None  # see residual_out
     # a (data, seq) jax.sharding.Mesh routes this module's attention core
     # through ring attention (parallel/ring.py) whenever the mask is a pure
     # key-padding mask and both sequence lengths divide the seq axis; adds
@@ -245,7 +260,7 @@ class Attention(nn.Module):
         out = out.transpose(0, 2, 1, 3).reshape(B, q_len, self.d_model)
         out = self.out_proj(out)
         out = self.dropout(out, deterministic=deterministic)
-        return self.norm(out + old_query)
+        return residual_out(self.norm(out + old_query), self.residual_dtype)
 
     def __call__(self, query, key, value, mask, *, deterministic: bool):
         k, v = self.project_kv(key, value)
@@ -259,6 +274,7 @@ class FeedForward(nn.Module):
     mult: int = 4
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
+    residual_dtype: object = None  # see residual_out
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool):
@@ -266,4 +282,6 @@ class FeedForward(nn.Module):
         h = jax.nn.relu(h)
         h = TorchDense(self.d_model, dtype=self.dtype, name="fc2")(h)
         h = nn.Dropout(self.dropout_rate, deterministic=deterministic)(h)
-        return nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype), name="norm")(h + x)
+        return residual_out(
+            nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype),
+                         name="norm")(h + x), self.residual_dtype)

@@ -104,6 +104,9 @@ class Encoder(nn.Module):
     cfg: FiraConfig
     dtype: jnp.dtype = jnp.float32
 
+    def _residual_dtype(self):
+        return None if self.cfg.stable_residual else self.dtype
+
     @nn.compact
     def __call__(self, diff, mark, ast_change, adj, sub_token,
                  *, deterministic: bool):
@@ -148,6 +151,7 @@ class Encoder(nn.Module):
             input_em = Combination(
                 num_heads=cfg.num_head, d_model=cfg.embedding_dim,
                 dropout_rate=cfg.dropout_rate, dtype=self.dtype,
+                residual_dtype=self._residual_dtype(),
                 name=f"combination_{i}",
             )(input_em, input_em, mark_em, deterministic=deterministic)
             # dynamic_update_slice does not promote dtypes the way the old
@@ -159,7 +163,8 @@ class Encoder(nn.Module):
                 graph_em, input_em.astype(graph_em.dtype), 0, axis=1)
             graph_em = GCN(
                 d_model=cfg.embedding_dim, dropout_rate=cfg.gcn_dropout_rate,
-                dtype=self.dtype, name=f"gcn_{i}",
+                dtype=self.dtype, residual_dtype=self._residual_dtype(),
+                name=f"gcn_{i}",
             )(graph_em, adj, deterministic=deterministic)
 
         return (graph_em[:, : cfg.sou_len],
@@ -190,19 +195,22 @@ class Decoder(nn.Module):
         for i in range(cfg.num_layers):
             # setattr keeps the historical per-layer scope names; Flax
             # registers setup attribute assignments whatever their spelling
+            rdt = None if cfg.stable_residual else self.dtype
             setattr(self, f"self_attn_{i}", Attention(
                 num_heads=cfg.num_head, d_model=cfg.embedding_dim,
-                dropout_rate=cfg.dropout_rate, dtype=self.dtype))
+                dropout_rate=cfg.dropout_rate, dtype=self.dtype,
+                residual_dtype=rdt))
             # only cross-attention rides the ring: its key axis ([diff||sub]
             # source states) is the one that grows with context length;
             # causal self-attention (4D mask) stays dense regardless
             setattr(self, f"cross_attn_{i}", Attention(
                 num_heads=cfg.num_head, d_model=cfg.embedding_dim,
                 dropout_rate=cfg.dropout_rate, dtype=self.dtype,
-                ring_mesh=self.ring_mesh))
+                residual_dtype=rdt, ring_mesh=self.ring_mesh))
             setattr(self, f"ffn_{i}", FeedForward(
                 d_model=cfg.embedding_dim, mult=cfg.ffn_mult,
-                dropout_rate=cfg.dropout_rate, dtype=self.dtype))
+                dropout_rate=cfg.dropout_rate, dtype=self.dtype,
+                residual_dtype=rdt))
 
     def _pos_table(self) -> jnp.ndarray:
         cfg = self.cfg
@@ -301,6 +309,7 @@ class CopyNet(nn.Module):
     d_model: int
     impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
+    remat: bool = True  # False stores the (B,T,S,D) tanh for backward
 
     def setup(self):
         self.src_proj = TorchDense(self.d_model, use_bias=False,
@@ -324,9 +333,13 @@ class CopyNet(nn.Module):
                 src, tgt, kernel.astype(self.dtype), bias.astype(self.dtype)
             )
         elif self.impl == "xla":
-            # remat: recompute the (B,T,S,D) tanh intermediate in backward
-            # instead of storing it (7.7 GB at the flagship geometry)
-            scores = jax.checkpoint(copy_score.copy_scores_reference)(
+            # remat (default): recompute the (B,T,S,D) tanh intermediate in
+            # backward instead of storing it; cfg.copy_head_remat=False
+            # stores it instead — values identical either way
+            fn = copy_score.copy_scores_reference
+            if self.remat:
+                fn = jax.checkpoint(fn)
+            scores = fn(
                 src, tgt, kernel.astype(self.dtype), bias.astype(self.dtype)
             )
         else:
@@ -364,7 +377,7 @@ class FiraModel(nn.Module):
         self.encoder = Encoder(cfg, dtype=self.dtype)
         self.decoder = Decoder(cfg, dtype=self.dtype, ring_mesh=ring_mesh)
         self.copy_net = CopyNet(cfg.embedding_dim, impl=cfg.copy_head_impl,
-                                dtype=self.dtype)
+                                dtype=self.dtype, remat=cfg.copy_head_remat)
         self.out_fc = TorchDense(cfg.vocab_size, dtype=self.dtype)
         if cfg.typed_edges:
             from fira_tpu.data.graph_build import N_EDGE_KINDS

@@ -467,13 +467,9 @@ class Parser {
     expect_op("(");
     while (!at_op(")")) {
       if (at_end()) err("unterminated record components");
-      size_t ps = mark();
-      Node* p = node("SingleVariableDeclaration");
-      parse_modifiers(p->children);  // component annotations
-      p->children.push_back(parse_type());
-      p->children.push_back(simple_name());
-      finish(p, ps);
-      n->children.push_back(p);
+      // components share the full parameter grammar (annotations, varargs,
+      // trailing [])
+      n->children.push_back(parse_param());
       if (at_op(",")) { advance(); continue; }
       break;
     }
@@ -904,7 +900,14 @@ class Parser {
     if (switch_depth_ > 0 && at_ident() && cur().text == "yield" &&
         !(peek().kind == Tok::Op &&
           (peek().text == "=" || peek().text == "." || peek().text == "::" ||
-           peek().text == "[" || peek().text == "(" || peek().text == ":"))) {
+           peek().text == "[" || peek().text == "(" || peek().text == ":" ||
+           // identifier-usage continuations that form a legal STATEMENT:
+           // compound assignment and inc/dec keep 'yield' a variable
+           // ('yield + 1;' is not a legal statement, so '+'/'-' stay yield)
+           peek().text == "++" || peek().text == "--" ||
+           (peek().text.size() >= 2 && peek().text.back() == '='
+            && peek().text != "==" && peek().text != "!="
+            && peek().text != ">=" && peek().text != "<=")))) {
       advance();
       Node* n = node("YieldStatement");
       n->children.push_back(parse_expression());

@@ -83,6 +83,7 @@ POST_JAVA13_CASES = {
     "instanceof_pattern": "class C { boolean f(Object o) { return o instanceof String s && s.isEmpty(); } }",
     "record": "record Point(int x, int y) { Point { if (x < 0) throw new IllegalArgumentException(); } }",
     "record_generic_impl": "record Pair<A, B>(A first, B second) implements java.io.Serializable { static Pair<Integer,Integer> of(int a, int b) { return new Pair<>(a, b); } }",
+    "record_varargs": "record R(int first, int... rest) { int n() { return 1 + rest.length; } }",
     "text_block": 'class C { String s = """\n  hello "world"\n  """; }',
     "sealed": "sealed interface Shape permits Circle, Square {} final class Circle implements Shape {} final class Square implements Shape {}",
     "non_sealed": "sealed class A permits B {} non-sealed class B extends A {}",
@@ -91,6 +92,7 @@ POST_JAVA13_CASES = {
 # contextual keywords must still work as plain identifiers
 CONTEXTUAL_IDENT_CASES = {
     "yield_as_ident": "class C { int yield = 3; int f() { return yield + yield; } void g(int x) { switch (x) { case 1: yield(5); break; } } }",
+    "yield_compound_assign": "class C { int yield = 1; void f(int x) { switch (x) { case 1: yield += 2; yield++; yield--; yield <<= 1; break; } } }",
     "record_as_ident": "class C { int record = 1; int f(int record) { return record + 1; } }",
     "sealed_as_ident": "class C { int sealed = 2; int permits = 3; int f() { return sealed + permits; } }",
 }

@@ -26,7 +26,9 @@ re-run across TPU-tunnel windows; finished stages are skipped.
 Env knobs: FS2_DIR (fullscale2), FS2_COMMITS (90661), FS2_EPOCHS (10),
 FS2_BATCH (170), FS2_DTYPE (bfloat16), FS2_CPU=1 (CPU smoke),
 FS2_VARIANTS (comma list, default all four), FS2_DEV_EVERY (200),
-FS2_TEST_BATCH (20).
+FS2_TEST_BATCH (20), FS2_OVERRIDES (JSON of FiraConfig fields — e.g. a
+reduced d/num_layers geometry for the CPU-scale insurance run; echoed in
+the report so a non-flagship geometry is always visible in the artifact).
 """
 
 from __future__ import annotations
@@ -87,14 +89,25 @@ def main() -> None:
     dev_every = int(os.environ.get("FS2_DEV_EVERY", "200"))
     test_batch = int(os.environ.get("FS2_TEST_BATCH", "20"))
     variants = os.environ.get("FS2_VARIANTS", ",".join(VARIANT_ORDER)).split(",")
+    overrides = json.loads(os.environ.get("FS2_OVERRIDES", "{}"))
     base = os.path.abspath(os.environ.get("FS2_DIR", "fullscale2"))
     data_dir = os.path.join(base, "DataSet")
     os.makedirs(base, exist_ok=True)
     report_path = os.path.join(base, "FULLSCALE2.json")
     report: dict = {"n_commits": n, "epochs": epochs, "batch_size": batch,
-                    "dtype": dtype, "signal_corpus": True, "variants": {}}
+                    "dtype": dtype, "signal_corpus": True, "variants": {},
+                    **({"config_overrides": overrides} if overrides else {})}
     if os.path.exists(report_path):
-        report.update(json.load(open(report_path)))
+        prior = json.load(open(report_path))
+        # a resume must use the geometry the campaign started with —
+        # checkpoints and caches are shape-bound, and the artifact must
+        # never claim overrides that don't match the applied config
+        if prior.get("config_overrides", {}) != overrides:
+            raise RuntimeError(
+                f"FS2_OVERRIDES {overrides} != the campaign's recorded "
+                f"{prior.get('config_overrides', {})} — resume with the "
+                f"original overrides or use a fresh FS2_DIR")
+        report.update(prior)
 
     def save_report():
         tmp = report_path + ".tmp"
@@ -126,7 +139,7 @@ def main() -> None:
         cfg = apply_ablation(
             fira_full(batch_size=batch, test_batch_size=test_batch,
                       compute_dtype=dtype, dev_start_epoch=0,
-                      dev_every_batches=dev_every),
+                      dev_every_batches=dev_every, **overrides),
             variant)
         t0 = time.time()
         dataset = FiraDataset(data_dir, cfg)  # npz cache keyed by ablation

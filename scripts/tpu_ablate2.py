@@ -11,6 +11,10 @@
   rbg_fused8  both
   det         dropout rates zeroed — what's left of the RNG cost
   batch340    2x batch (per-sample cost check at the bigger tile)
+  bf16_residual  stable_residual=False: inter-layer activations stored bf16
+  no_remat    copy_head_remat=False: store the tanh intermediate instead of
+              recomputing it in backward
+  stacked     all cheap knobs together (the candidate production config)
 
 Baseline to compare against: 106.87 ms/step (pre-optimization base,
 BENCH_ATTEMPTS_r03.json attempt 7).
@@ -98,3 +102,10 @@ measure("fused8", fused=8)
 measure("rbg_fused8", rng_impl="rbg", fused=8)
 measure("det", dropout_rate=0.0, gcn_dropout_rate=0.0)
 measure("batch340", batch=340)
+measure("bf16_residual", stable_residual=False)
+measure("no_remat", copy_head_remat=False)
+# every cheap knob at once: the candidate production configuration
+measure("stacked", rng_impl="rbg", fused=8, sort_edges=True,
+        stable_residual=False, copy_head_remat=False)
+measure("stacked_b340", rng_impl="rbg", fused=4, sort_edges=True,
+        stable_residual=False, copy_head_remat=False, batch=340)

@@ -81,3 +81,45 @@ def test_dev_predict_shape(tiny):
     ids = model.apply(params, jbatch, method=FiraModel.dev_predict)
     assert ids.shape == jbatch["msg"].shape
     assert int(ids.max()) < cfg.output_vocab_size
+
+
+class TestPerfKnobs:
+    """stable_residual / copy_head_remat are perf knobs, not semantics:
+    exactness pins for the cheap directions, tolerance for bf16."""
+
+    def test_stable_residual_off_is_exact_in_f32(self, tiny):
+        cfg, model, params, jbatch = tiny
+        m2 = FiraModel(cfg.replace(stable_residual=False))
+        a = model.apply(params, jbatch, deterministic=True)
+        b = m2.apply(params, jbatch, deterministic=True)
+        assert float(a[0]) == float(b[0])  # astype is a no-op in f32
+
+    def test_stable_residual_off_close_in_bf16(self, tiny):
+        cfg, _, params, jbatch = tiny
+        ma = FiraModel(cfg.replace(compute_dtype="bfloat16"),
+                       dtype=jnp.bfloat16)
+        mb = FiraModel(cfg.replace(compute_dtype="bfloat16",
+                                   stable_residual=False),
+                       dtype=jnp.bfloat16)
+        a = ma.apply(params, jbatch, deterministic=True)
+        b = mb.apply(params, jbatch, deterministic=True)
+        la, lb = float(a[0]) / float(a[1]), float(b[0]) / float(b[1])
+        assert abs(la - lb) / abs(la) < 0.02, (la, lb)
+
+    def test_copy_head_remat_off_identical_loss_and_grads(self, tiny):
+        cfg, model, params, jbatch = tiny
+        m2 = FiraModel(cfg.replace(copy_head_remat=False))
+
+        def loss(m):
+            def f(p):
+                s, c = m.apply({"params": p["params"]}, jbatch,
+                               deterministic=True)
+                return s / c
+            return f
+
+        la, ga = jax.value_and_grad(loss(model))(params)
+        lb, gb = jax.value_and_grad(loss(m2))(params)
+        assert float(la) == float(lb)
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y)), ga, gb)
