@@ -91,7 +91,9 @@ class Parser {
   // Python's default json.loads recursion budget.
   static constexpr int kMaxDepth = 300;
   int depth_ = 0;
-  int switch_depth_ = 0;  // yield is a statement only inside switch bodies
+  int switch_expr_depth_ = 0;  // yield is a statement ONLY inside switch
+                               // EXPRESSION bodies (JLS 14.21) — in switch
+                               // STATEMENTS 'yield' stays an identifier
   struct DepthGuard {
     Parser& p;
     explicit DepthGuard(Parser& pp) : p(pp) {
@@ -894,20 +896,12 @@ class Parser {
       finish(n, s);
       return n;
     }
-    // yield statement (contextual keyword, Java 14): only inside a switch
-    // body, and not when 'yield' is being used as a plain identifier
-    // (assignment / qualifier / call / label all keep it an identifier)
-    if (switch_depth_ > 0 && at_ident() && cur().text == "yield" &&
-        !(peek().kind == Tok::Op &&
-          (peek().text == "=" || peek().text == "." || peek().text == "::" ||
-           peek().text == "[" || peek().text == "(" || peek().text == ":" ||
-           // identifier-usage continuations that form a legal STATEMENT:
-           // compound assignment and inc/dec keep 'yield' a variable
-           // ('yield + 1;' is not a legal statement, so '+'/'-' stay yield)
-           peek().text == "++" || peek().text == "--" ||
-           (peek().text.size() >= 2 && peek().text.back() == '='
-            && peek().text != "==" && peek().text != "!="
-            && peek().text != ">=" && peek().text != "<=")))) {
+    // yield statement (contextual keyword, Java 14): inside a switch
+    // EXPRESSION body a statement starting with 'yield' is always the
+    // yield statement (JLS 14.21 — assigning to a variable named yield
+    // there requires qualification); in switch STATEMENTS this branch is
+    // dead and 'yield' remains a plain identifier
+    if (switch_expr_depth_ > 0 && at_ident() && cur().text == "yield") {
       advance();
       Node* n = node("YieldStatement");
       n->children.push_back(parse_expression());
@@ -1063,19 +1057,22 @@ class Parser {
     expect_op("(");
     n->children.push_back(parse_expression());
     expect_op(")");
-    parse_switch_block(n);
+    parse_switch_block(n, /*is_expr=*/false);
     finish(n, s);
     return n;
   }
 
   // Shared by SwitchStatement and SwitchExpression: classic `case X:` arms,
   // Java 14 `case A, B -> body` arms (body = expression ';' | block |
-  // throw), and yield statements (recognized inside switch bodies only).
-  void parse_switch_block(Node* n) {
+  // throw). Yield statements are recognized only under is_expr (JLS 14.21:
+  // yield exists only in switch-expression bodies; javac parses any
+  // statement there starting with 'yield' as a YieldStatement, while in a
+  // switch STATEMENT 'yield' is an ordinary identifier).
+  void parse_switch_block(Node* n, bool is_expr) {
     expect_op("{");
-    ++switch_depth_;
+    switch_expr_depth_ += is_expr ? 1 : 0;
     while (!at_op("}")) {
-      if (at_end()) { --switch_depth_; err("unterminated switch"); }
+      if (at_end()) { switch_expr_depth_ -= is_expr ? 1 : 0; err("unterminated switch"); }
       if (at_kw("case") || at_kw("default")) {
         size_t cs = mark();
         Node* c = node("SwitchCase");
@@ -1115,7 +1112,7 @@ class Parser {
       }
     }
     advance();
-    --switch_depth_;
+    switch_expr_depth_ -= is_expr ? 1 : 0;
   }
 
   Node* parse_try(size_t s) {
@@ -1654,7 +1651,7 @@ class Parser {
       expect_op("(");
       n->children.push_back(parse_expression());
       expect_op(")");
-      parse_switch_block(n);
+      parse_switch_block(n, /*is_expr=*/true);
       finish(n, s);
       return n;
     }

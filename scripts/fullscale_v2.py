@@ -136,11 +136,11 @@ def main() -> None:
 
     for variant in variants:
         vrep = report["variants"].setdefault(variant, {})
-        cfg = apply_ablation(
-            fira_full(batch_size=batch, test_batch_size=test_batch,
-                      compute_dtype=dtype, dev_start_epoch=0,
-                      dev_every_batches=dev_every, **overrides),
-            variant)
+        base_kw = dict(batch_size=batch, test_batch_size=test_batch,
+                       compute_dtype=dtype, dev_start_epoch=0,
+                       dev_every_batches=dev_every)
+        base_kw.update(overrides)  # FS2_OVERRIDES wins over the env knobs
+        cfg = apply_ablation(fira_full(**base_kw), variant)
         t0 = time.time()
         dataset = FiraDataset(data_dir, cfg)  # npz cache keyed by ablation
         cfg = dataset.cfg

@@ -90,7 +90,7 @@ def measure(tag, rng_impl="threefry", fused=1, sort_edges=False,
     n_steps = steps_per_call * calls
     dt = sorted(times)[1] / n_steps
     print(json.dumps({"tag": tag, "step_ms": round(dt * 1e3, 2),
-                      "commits_per_sec": round(170 / dt, 1),
+                      "commits_per_sec": round(batch / dt, 1),
                       "loss_finite": bool(np.isfinite(loss)),
                       "compile_s": round(compile_s, 1)}), flush=True)
 
