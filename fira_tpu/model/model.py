@@ -426,12 +426,16 @@ class FiraModel(nn.Module):
         mask = jnp.concatenate([sou_mask, sub_mask], axis=1)
         return states, mask
 
-    def fused_probs(self, states, mask, tar, tar_mask_pad, *,
+    def _dist_parts(self, states, mask, tar, tar_mask_pad, *,
                     deterministic: bool = True):
-        """Decoder + copy fusion -> probability-space distribution over
-        vocab_size + sou_len + sub_token_len (Model.py:52-64). The beam
-        search consumes this directly in its reference-compat prob-space
-        accumulation mode (run_model.py:257-271)."""
+        """The fused distribution's three factors — generation softmax over
+        the vocab, copy softmax over source positions, 2-way gate — WITHOUT
+        assembling the (B, T, vocab+sou+sub) concatenation. The training
+        loss gathers one label per position from the factors directly
+        (gate*dist then gather == gather then gate — multiplication is
+        elementwise), skipping ~1.5 GB/step of full-vocab f32 assembly at
+        flagship geometry; the beam consumes the assembled form via
+        :meth:`fused_probs`."""
         tar_emb = self.decoder(tar, states, mask, tar_mask_pad,
                                deterministic=deterministic)
         gen = jax.nn.softmax(
@@ -440,6 +444,16 @@ class FiraModel(nn.Module):
         scores, gate = self.copy_net(states, tar_emb)
         scores = jnp.where(mask[:, None, :], scores, jnp.asarray(-1e9, scores.dtype))
         copy = jax.nn.softmax(scores.astype(stable_dtype(self.dtype)), axis=-1)
+        return gen, copy, gate
+
+    def fused_probs(self, states, mask, tar, tar_mask_pad, *,
+                    deterministic: bool = True):
+        """Decoder + copy fusion -> probability-space distribution over
+        vocab_size + sou_len + sub_token_len (Model.py:52-64). The beam
+        search consumes this directly in its reference-compat prob-space
+        accumulation mode (run_model.py:257-271)."""
+        gen, copy, gate = self._dist_parts(states, mask, tar, tar_mask_pad,
+                                           deterministic=deterministic)
         return jnp.concatenate(
             [gate[:, :, 0:1] * gen, gate[:, :, 1:2] * copy], axis=-1
         )
@@ -486,7 +500,7 @@ class FiraModel(nn.Module):
         reference (Model.py:83-84); callers normalize (run_model.py:105)."""
         states, mask = self.encode(batch, deterministic=deterministic)
         tar = batch["msg"]
-        fused = self.fused_probs(
+        gen, copy, gate = self._dist_parts(
             states, mask, tar, tar != 0, deterministic=deterministic
         )
         # label = tar_label shifted left with a zero column (Model.py:71-79)
@@ -496,12 +510,21 @@ class FiraModel(nn.Module):
             axis=1,
         )
         label_mask = label != 0
-        # Gather the label's probability FIRST, then log-clamp (Model.py:69's
-        # clip to [1e-10, 1]) — elementwise log commutes with the gather, so
-        # this is the same nll as log-clamping the whole (B, T, 25k)
-        # distribution and gathering after, without materializing that full
-        # f32 log tensor (~0.5 GB/step at flagship) in forward and backward.
-        p = jnp.take_along_axis(fused, label[..., None], axis=-1)[..., 0]
+        # Gather the label's probability from the distribution FACTORS, then
+        # log-clamp (Model.py:69's clip to [1e-10, 1]). Equivalent to
+        # assembling the fused (B, T, 25k) tensor, log-clamping it, and
+        # gathering after — gate multiplication and log are elementwise, so
+        # both commute with the gather — but neither the concatenation nor
+        # the full-vocab gate products nor the full f32 log tensor
+        # (~2 GB/step combined at flagship) is ever materialized.
+        V = self.cfg.vocab_size
+        label = label.astype(jnp.int32)
+        is_gen = label < V
+        gi = jnp.where(is_gen, label, 0)[..., None]
+        ci = jnp.clip(label - V, 0, copy.shape[-1] - 1)[..., None]
+        pg = jnp.take_along_axis(gen, gi, axis=-1)[..., 0] * gate[..., 0]
+        pc = jnp.take_along_axis(copy, ci, axis=-1)[..., 0] * gate[..., 1]
+        p = jnp.where(is_gen, pg, pc)
         nll = -jnp.log(jnp.clip(p, 1e-10, 1.0))
         nll = jnp.where(label_mask, nll, 0.0)
         return nll.sum(), label_mask.sum()

@@ -103,6 +103,18 @@ class Parser {
     ~DepthGuard() { --p.depth_; }
   };
 
+  // RAII like DepthGuard: speculative-parse catches restore token
+  // position but not counters, so the expression-switch depth must unwind
+  // on ANY exit (a leak would misparse later 'yield' identifiers)
+  struct SwitchExprGuard {
+    Parser& p;
+    bool on;
+    SwitchExprGuard(Parser& pp, bool is_expr) : p(pp), on(is_expr) {
+      if (on) ++p.switch_expr_depth_;
+    }
+    ~SwitchExprGuard() { if (on) --p.switch_expr_depth_; }
+  };
+
   struct State { size_t p, undo; };
   State save() { return {p_, undo_.size()}; }
   void restore(const State& st) {
@@ -1070,9 +1082,9 @@ class Parser {
   // switch STATEMENT 'yield' is an ordinary identifier).
   void parse_switch_block(Node* n, bool is_expr) {
     expect_op("{");
-    switch_expr_depth_ += is_expr ? 1 : 0;
+    SwitchExprGuard guard(*this, is_expr);
     while (!at_op("}")) {
-      if (at_end()) { switch_expr_depth_ -= is_expr ? 1 : 0; err("unterminated switch"); }
+      if (at_end()) err("unterminated switch");
       if (at_kw("case") || at_kw("default")) {
         size_t cs = mark();
         Node* c = node("SwitchCase");
@@ -1112,7 +1124,6 @@ class Parser {
       }
     }
     advance();
-    switch_expr_depth_ -= is_expr ? 1 : 0;
   }
 
   Node* parse_try(size_t s) {

@@ -215,3 +215,41 @@ class TestExtractCommit:
         chunks, types = split_hunks(tokens, marks)
         g = extract.extract_commit(chunks, types, tokens)
         assert g.change == [] and g.edge_change_code == []
+
+
+class TestModernJavaConstructs:
+    """Round-4 grammar additions flow through the WHOLE extraction path
+    (wrapper analysis -> native parse -> leaf mapping -> edges), not just
+    the parser: the reference's JDT 3.16 would degrade these hunks to
+    code-tokens-only; here they produce a real AST side-graph."""
+
+    def test_switch_expression_statement_extracts(self):
+        toks = ["int", "r", "=", "switch", "(", "x", ")", "{",
+                "case", "1", "->", "2", ";", "default", "->", "3", ";",
+                "}", ";"]
+        _, side = extract.parse_fragment(toks)
+        assert side.ast_tokens, "switch expression must produce AST nodes"
+        assert "SwitchExpression".lower() in [t.lower() for t in side.ast_tokens]
+        names = {toks[j] for j in side.dmap_code.values()}
+        assert {"r", "x"} <= names
+
+    def test_instanceof_pattern_extracts(self):
+        toks = ["boolean", "b", "=", "o", "instanceof", "String", "s", ";"]
+        _, side = extract.parse_fragment(toks)
+        assert side.ast_tokens
+        names = {toks[j] for j in side.dmap_code.values()}
+        assert {"b", "o", "s"} <= names
+
+    def test_update_chunk_with_switch_arrow_diff(self):
+        old = ["int", "r", "=", "switch", "(", "x", ")", "{",
+               "case", "1", "->", "2", ";", "default", "->", "3", ";",
+               "}", ";"]
+        new = ["int", "r", "=", "switch", "(", "x", ")", "{",
+               "case", "1", "->", "9", ";", "default", "->", "3", ";",
+               "}", ";"]
+        g = extract.update_chunk_edges(old, new)
+        # the edit is inside the switch arm: change nodes must exist and
+        # point at valid positions on both sides
+        assert g.change
+        for c, j in g.edge_change_code_old + g.edge_change_code_new:
+            assert 0 <= c < len(g.change)
