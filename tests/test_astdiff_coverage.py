@@ -37,3 +37,47 @@ def test_broken_input_degrades_cleanly(name):
     from fira_tpu.preprocess.astdiff_binding import parse_json
 
     assert parse_json(DEGRADE_CASES[name]) is None
+
+
+def test_random_construct_nesting_never_crashes():
+    """Safety fuzz: random compositions of the round-4 grammar (switch
+    expressions in lambdas in records in sealed hierarchies...) must parse
+    or cleanly degrade to None — the in-process library must never take the
+    worker down (the reference tolerates GumTree subprocess death; we have
+    no process boundary to hide behind)."""
+    import random
+
+    from fira_tpu.preprocess.astdiff_binding import parse_json
+
+    rng = random.Random(0)
+
+    def expr(depth):
+        if depth <= 0:
+            return rng.choice(["1", "x", '"s"', "f()"])
+        return rng.choice([
+            "switch (%s) { case 1 -> %s; default -> %s; }"
+            % (expr(0), expr(depth - 1), expr(depth - 1)),
+            "switch (%s) { case 1: yield %s; default: yield %s; }"
+            % (expr(0), expr(depth - 1), expr(depth - 1)),
+            "((java.util.function.Supplier<Object>) () -> %s).get()"
+            % expr(depth - 1),
+            "(%s instanceof String s ? s : %s)" % (expr(0), expr(depth - 1)),
+            "new Object[]{ %s, %s }[0]" % (expr(depth - 1), expr(0)),
+        ])
+
+    def decl(depth, i):
+        body = "Object f%d() { return %s; }" % (i, expr(depth))
+        return rng.choice([
+            "class C%d { %s }" % (i, body),
+            "record R%d(int a, int... b) { %s }" % (i, body),
+            "sealed interface I%d permits J%d {} final class J%d implements I%d { %s }"
+            % (i, i, i, i, body),
+        ])
+
+    n_parsed = 0
+    for i in range(60):
+        src = decl(rng.randint(1, 4), i)
+        tree = parse_json(src)  # None (degrade) is fine; crashing is not
+        n_parsed += tree is not None
+    # the generator emits only legal Java, so most must actually parse
+    assert n_parsed >= 50, n_parsed
