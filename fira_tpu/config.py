@@ -54,7 +54,13 @@ class FiraConfig:
     # NOT a dense graph_len^2 array (the reference densifies per sample,
     # Dataset.py:336-343 — its biggest throughput sin). Densification to a
     # batch of graph_len^2 happens once per step inside the jitted program.
-    max_edges: int = 8192       # padded COO length per sample (measured p100 < 6k)
+    # Padded COO length per sample. The full-scale 90,661-commit corpus
+    # measures p100 < 6,000 edges (fullscale/FULLSCALE.json era builds), so
+    # 6144 keeps headroom while cutting the per-step adjacency scatter
+    # stream 25% vs the old 8192 (the scatter is the single biggest op in
+    # the round-4 step attribution, scripts/tpu_diag3.py ~22 ms of 86).
+    # make_batch raises loudly if a sample ever exceeds it.
+    max_edges: int = 6144
     # "dense": scatter COO into a (B, graph_len^2) adjacency once per step and
     #   run the GCN as a bmm (MXU-friendly at the reference's 650 nodes);
     # "segment": gather/scatter message passing directly on the COO triplets —
@@ -193,7 +199,6 @@ def fira_large(**kw) -> FiraConfig:
         embedding_dim=512,
         num_layers=8,
         beam_size=8,
-        max_edges=8192,
     )
     base.update(kw)
     return FiraConfig(**base)

@@ -38,6 +38,25 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
             src = np.concatenate([src, pad])
         batch[f] = src
 
+    # --- narrow wire dtypes: ids ship int16/int8 and consumers upcast on
+    # device (the loss path casts labels to int32, scatters cast indices,
+    # embeds take any integer dtype). H2D is a per-step cost on every rig
+    # and THE cost on thin host links; ids are ~7% and edge arrays ~93% of
+    # the batch bytes, so together with the compute-dtype edge values below
+    # this takes ~9 MB/batch-170 to ~5. Preconditions are enforced loudly:
+    # a config scaled past a narrow dtype's range must fail here, not wrap
+    # silently on device.
+    if cfg.output_vocab_size - 1 > np.iinfo(np.int16).max:
+        raise ValueError(
+            f"output_vocab_size={cfg.output_vocab_size} exceeds int16 wire "
+            f"range (max id {np.iinfo(np.int16).max}); widen the id dtype")
+    for f in ("diff", "msg", "msg_tar", "sub_token"):
+        batch[f] = batch[f].astype(np.int16)
+    batch["diff_mark"] = batch["diff_mark"].astype(np.int8)  # values 0..3
+    ast_dt = (np.int8 if cfg.ast_change_vocab_size - 1 <= np.iinfo(np.int8).max
+              else np.int16)
+    batch["ast_change"] = batch["ast_change"].astype(ast_dt)
+
     # int16 indices: graph_len caps at 650 << 32767, and edge arrays dominate
     # the per-step host->device transfer (the model upcasts on device).
     # Enforce the dtype's precondition: a config scaled past int16 range
@@ -79,6 +98,21 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
 
     batch["senders"] = senders
     batch["receivers"] = receivers
+    if (cfg.compute_dtype == "bfloat16" and cfg.adjacency_impl == "dense"
+            and not cfg.typed_edges):
+        # Ship edge values in the compute dtype: the dense path scatters
+        # them straight into a bf16 adjacency (dense_adjacency out_dtype),
+        # and host-side f32->bf16 rounding is the same rounding the device
+        # cast performs, so the adjacency is bit-identical while the values
+        # array (the single largest wire field) halves. Confined to exactly
+        # that path: the segment path multiplies exact f32 values inside
+        # its f32 accumulator, and typed_edges scales values by learned
+        # gains before the cast — both would see pre-rounded inputs and
+        # drift from their f32-wire behavior. f32 compute keeps the f32
+        # wire — the parity path is untouched.
+        import ml_dtypes
+
+        values = values.astype(ml_dtypes.bfloat16)
     batch["values"] = values
     if kinds is not None:
         # only shipped when the typed-edge extension is on — the flattened

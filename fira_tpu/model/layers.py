@@ -216,8 +216,9 @@ class Attention(nn.Module):
 
     def _ring_applicable(self, q, k, mask) -> bool:
         if self.ring_mesh is None or mask.ndim != 2:
-            # 4D masks (causal self-attention) stay on the dense path; ring
-            # carries key-padding semantics only
+            # ring carries key-padding semantics only; callers with richer
+            # masking (4D decode-step masks, or causal=True — excluded at
+            # the attend() call site) stay on the dense path
             return False
         from fira_tpu.parallel.ring import SEQ_AXIS
 
@@ -236,14 +237,24 @@ class Attention(nn.Module):
         return self._split_heads(self.k_proj(key)), \
             self._split_heads(self.v_proj(value))
 
-    def attend(self, query, k, v, mask, *, deterministic: bool):
-        """Attention over pre-projected K/V (as returned by project_kv)."""
+    def attend(self, query, k, v, mask, *, deterministic: bool,
+               causal: bool = False):
+        """Attention over pre-projected K/V (as returned by project_kv).
+
+        ``causal=True`` applies the lower-triangular mask as a SEPARATE
+        broadcast where-term over the logits instead of expecting it folded
+        into ``mask``: pad AND causal -> one (B,1,T,T) boolean buffer that
+        XLA materializes and copies between fusions (~4 ms/step of pred
+        copies in the round-4 per-op trace, docs/TPU_OP_TIMES.json); two
+        chained wheres with (B,1,1,T) and (1,1,T,T) operands fuse into the
+        logits computation with no batched mask buffer. Elementwise
+        identical: both fills are the same -1e9."""
         old_query = query
         B, q_len = query.shape[0], query.shape[1]
         d_head = self.d_model // self.num_heads
 
         q = self._split_heads(self.q_proj(query))
-        if self._ring_applicable(q, k, mask):
+        if not causal and self._ring_applicable(q, k, mask):
             # sequence-parallel exact attention: K/V blocks rotate over the
             # seq mesh axis with an online softmax (same -1e9 key-padding
             # semantics as the dense branch below)
@@ -255,6 +266,10 @@ class Attention(nn.Module):
             if mask.ndim < 4:  # (B, kv_len) key-padding mask -> (B,1,1,kv)
                 mask = mask[:, None, None, :]
             weight = jnp.where(mask == 0, jnp.asarray(-1e9, weight.dtype), weight)
+            if causal:
+                tri = jnp.tril(jnp.ones((q_len, k.shape[2]), dtype=bool))
+                weight = jnp.where(tri[None, None],
+                                   weight, jnp.asarray(-1e9, weight.dtype))
             weight = jax.nn.softmax(weight.astype(stable_dtype(self.dtype)), axis=-1).astype(self.dtype)
             out = jnp.einsum("bhqk,bhkd->bhqd", weight, v)
         out = out.transpose(0, 2, 1, 3).reshape(B, q_len, self.d_model)
@@ -262,9 +277,11 @@ class Attention(nn.Module):
         out = self.dropout(out, deterministic=deterministic)
         return residual_out(self.norm(out + old_query), self.residual_dtype)
 
-    def __call__(self, query, key, value, mask, *, deterministic: bool):
+    def __call__(self, query, key, value, mask, *, deterministic: bool,
+                 causal: bool = False):
         k, v = self.project_kv(key, value)
-        return self.attend(query, k, v, mask, deterministic=deterministic)
+        return self.attend(query, k, v, mask, deterministic=deterministic,
+                           causal=causal)
 
 
 class FeedForward(nn.Module):

@@ -202,7 +202,8 @@ class Decoder(nn.Module):
                 residual_dtype=rdt))
             # only cross-attention rides the ring: its key axis ([diff||sub]
             # source states) is the one that grows with context length;
-            # causal self-attention (4D mask) stays dense regardless
+            # causal self-attention stays dense (attend() keeps causal=True
+            # off the ring path, and these layers get no ring_mesh)
             setattr(self, f"cross_attn_{i}", Attention(
                 num_heads=cfg.num_head, d_model=cfg.embedding_dim,
                 dropout_rate=cfg.dropout_rate, dtype=self.dtype,
@@ -223,13 +224,13 @@ class Decoder(nn.Module):
         T = tar.shape[1]
         x = self.embed(tar) + self._pos_table()[None, :T, :]
 
-        causal = jnp.tril(jnp.ones((T, T), dtype=bool))
-        # (B,1,1,T) pad mask AND (1,1,T,T) causal (gnn_transformer.py:117)
-        tar_mask = tar_mask_pad[:, None, None, :] & causal[None, None, :, :]
-
+        # (B,1,1,T) pad mask AND (1,1,T,T) causal (gnn_transformer.py:117),
+        # applied as two chained where-terms inside attend (causal=True) so
+        # the combined (B,1,T,T) boolean buffer never materializes
         for i in range(cfg.num_layers):
             x = getattr(self, f"self_attn_{i}")(
-                x, x, x, tar_mask, deterministic=deterministic)
+                x, x, x, tar_mask_pad, deterministic=deterministic,
+                causal=True)
             x = getattr(self, f"cross_attn_{i}")(
                 x, sou_embedding, sou_embedding, sou_mask,
                 deterministic=deterministic)

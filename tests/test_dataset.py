@@ -97,6 +97,40 @@ def test_batching_fixed_shapes(corpus_dir):
         assert (last["msg_tar"][n_real:] == 0).all()
 
 
+def test_wire_dtypes_are_narrow(corpus_dir):
+    """Ids travel int16/int8 and edge values travel the compute dtype:
+    H2D bytes are a per-step cost (THE cost on thin host links), and the
+    device side upcasts everywhere it matters. Pins the wire format so a
+    refactor can't silently reintroduce the fat int32/f32 wire."""
+    import dataclasses
+
+    import ml_dtypes
+
+    cfg = FiraConfig(batch_size=8)
+    ds = FiraDataset(corpus_dir, cfg)
+    b = make_batch(ds.splits["train"], np.arange(8), ds.cfg)
+    for f in ("diff", "msg", "msg_tar", "sub_token"):
+        assert b[f].dtype == np.int16, f
+    assert b["diff_mark"].dtype == np.int8
+    assert b["ast_change"].dtype == np.int8  # vocab 71 fits
+    assert b["senders"].dtype == np.int16
+    assert b["values"].dtype == np.float32  # f32 compute keeps the f32 wire
+
+    # narrowing is lossless: ids round-trip to the stored int32 arrays
+    for f in ("diff", "msg", "msg_tar", "diff_mark", "ast_change",
+              "sub_token"):
+        np.testing.assert_array_equal(
+            b[f].astype(np.int64), ds.splits["train"].arrays[f][:8])
+
+    # bf16 compute ships bf16 edge values — the same rounding the device
+    # cast performs, so the scattered adjacency is bit-identical
+    cfg_bf16 = dataclasses.replace(ds.cfg, compute_dtype="bfloat16")
+    b16 = make_batch(ds.splits["train"], np.arange(8), cfg_bf16)
+    assert b16["values"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        b16["values"], b["values"].astype(ml_dtypes.bfloat16))
+
+
 def test_sort_edges_is_semantically_identical(corpus_dir):
     """cfg.sort_edges permutes each sample's COO triplets by cell index;
     the scattered adjacency (and hence every downstream number) must be
