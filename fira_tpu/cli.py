@@ -95,14 +95,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="train: write a jax.profiler trace of a steady-state "
                         "step window here (TensorBoard-loadable)")
+    p.add_argument("--perf", default=None, choices=["parity", "production"],
+                   help="knob preset: 'production' applies the measured "
+                        "fastest TPU config (config.PRODUCTION_PERF_KNOBS: "
+                        "rbg dropout PRNG, fused device loop, sorted "
+                        "scatters, bf16 residual streams, no copy-head "
+                        "remat — docs/PERF.md); 'parity' (default) keeps "
+                        "the reference-parity knob defaults. Individual "
+                        "flags override the preset either way")
     return p
 
 
 def _resolve_cfg(args):
-    from fira_tpu.config import apply_ablation, get_config
+    from fira_tpu.config import (PRODUCTION_PERF_KNOBS, apply_ablation,
+                                 get_config)
 
     cfg = get_config(args.config.replace("_", "-"))
     cfg = apply_ablation(cfg, args.ablation)
+    if args.perf == "production":
+        cfg = cfg.replace(**PRODUCTION_PERF_KNOBS)
     overrides = {}
     if args.batch_size:
         overrides["batch_size"] = args.batch_size
@@ -128,6 +139,12 @@ def _resolve_cfg(args):
         overrides["sort_edges"] = True
     if args.typed_edges:
         overrides["typed_edges"] = True
+    # --accum-steps conflicts with the production preset's fused device
+    # loop (mutually exclusive by config contract); an explicit accum
+    # request drops the preset's fused_steps unless the user also pinned it
+    if (overrides.get("accum_steps", 1) > 1 and cfg.fused_steps > 1
+            and "fused_steps" not in overrides):
+        overrides["fused_steps"] = 1
     return cfg.replace(**overrides) if overrides else cfg
 
 

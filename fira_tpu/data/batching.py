@@ -53,6 +53,10 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
     for f in ("diff", "msg", "msg_tar", "sub_token"):
         batch[f] = batch[f].astype(np.int16)
     batch["diff_mark"] = batch["diff_mark"].astype(np.int8)  # values 0..3
+    if cfg.ast_change_vocab_size - 1 > np.iinfo(np.int16).max:
+        raise ValueError(
+            f"ast_change_vocab_size={cfg.ast_change_vocab_size} exceeds "
+            f"int16 wire range; widen the id dtype")
     ast_dt = (np.int8 if cfg.ast_change_vocab_size - 1 <= np.iinfo(np.int8).max
               else np.int16)
     batch["ast_change"] = batch["ast_change"].astype(ast_dt)

@@ -95,17 +95,36 @@ def measure(tag, rng_impl="threefry", fused=1, sort_edges=False,
                       "compile_s": round(compile_s, 1)}), flush=True)
 
 
-measure("base")
-measure("rbg", rng_impl="rbg")
-measure("sorted_scatter", sort_edges=True)
-measure("fused8", fused=8)
-measure("rbg_fused8", rng_impl="rbg", fused=8)
-measure("det", dropout_rate=0.0, gcn_dropout_rate=0.0)
-measure("batch340", batch=340)
-measure("bf16_residual", stable_residual=False)
-measure("no_remat", copy_head_remat=False)
+# FIRA_ABLATE2_ONLY=tag,tag runs a subset (e.g. "base,stacked" to re-pin
+# the endpoints after a code change without the 25-min full sweep)
+_only = os.environ.get("FIRA_ABLATE2_ONLY", "")
+_only = {t.strip() for t in _only.split(",") if t.strip()} if _only else None
+_ran: set = set()
+
+
+def maybe(tag, **kw):
+    if _only is None or tag in _only:
+        _ran.add(tag)
+        measure(tag, **kw)
+
+
+maybe("base")
+maybe("rbg", rng_impl="rbg")
+maybe("sorted_scatter", sort_edges=True)
+maybe("fused8", fused=8)
+maybe("rbg_fused8", rng_impl="rbg", fused=8)
+maybe("det", dropout_rate=0.0, gcn_dropout_rate=0.0)
+maybe("batch340", batch=340)
+maybe("bf16_residual", stable_residual=False)
+maybe("no_remat", copy_head_remat=False)
 # every cheap knob at once: the candidate production configuration
-measure("stacked", rng_impl="rbg", fused=8, sort_edges=True,
-        stable_residual=False, copy_head_remat=False)
-measure("stacked_b340", rng_impl="rbg", fused=4, sort_edges=True,
-        stable_residual=False, copy_head_remat=False, batch=340)
+maybe("stacked", rng_impl="rbg", fused=8, sort_edges=True,
+      stable_residual=False, copy_head_remat=False)
+maybe("stacked_b340", rng_impl="rbg", fused=4, sort_edges=True,
+      stable_residual=False, copy_head_remat=False, batch=340)
+
+if _only is not None and _only - _ran:
+    # a typo'd tag silently measuring nothing would waste a TPU window
+    print(json.dumps({"error": f"unknown tags: {sorted(_only - _ran)}"}),
+          flush=True)
+    sys.exit(2)

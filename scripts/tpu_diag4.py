@@ -43,8 +43,11 @@ N = 8
 B = 170
 
 
-def batch_for(max_edges: int, sort: bool):
-    cfg = fira_full(batch_size=B, compute_dtype="bfloat16",
+def batch_for(max_edges: int, sort: bool, dtype: str = "bfloat16"):
+    # NOTE dtype also controls the wire: make_batch ships bf16 edge values
+    # under bf16 compute (dense/untyped path), f32 otherwise — the f32
+    # continuity row below must therefore build an f32 config
+    cfg = fira_full(batch_size=B, compute_dtype=dtype,
                     max_edges=max_edges, sort_edges=sort)
     cfg, split, _ = make_memory_split(cfg, 256, seed=0)
     rng = np.random.RandomState(0)
@@ -84,7 +87,7 @@ def flat_adjacency(senders, receivers, values, graph_len, sorted_flag):
     return out.reshape(Bx, graph_len, graph_len)
 
 
-cfg_f32, d_8192 = batch_for(8192, sort=False)
+cfg_f32, d_8192 = batch_for(8192, sort=False, dtype="float32")
 GL = cfg_f32.graph_len
 
 timeit("scatter_8192_f32",

@@ -1,0 +1,88 @@
+"""CLI surface tests: flag->config resolution and the train|test commands
+end-to-end on a tiny synthetic corpus (the reference's only driver surface
+is `python run_model.py train|test`, run_model.py:417-425 — this is its
+replacement, so the entry point itself deserves coverage, not just the
+layers under it)."""
+
+import os
+
+import numpy as np
+
+from fira_tpu import cli
+from fira_tpu.config import PRODUCTION_PERF_KNOBS
+
+
+def _cfg(argv):
+    args = cli.build_parser().parse_args(argv)
+    return cli._resolve_cfg(args)
+
+
+def test_parity_defaults():
+    cfg = _cfg(["train"])
+    assert cfg.rng_impl == "threefry"
+    assert cfg.fused_steps == 1
+    assert cfg.sort_edges is False
+    assert cfg.stable_residual is True
+    assert cfg.copy_head_remat is True
+
+
+def test_production_preset_is_valid_and_applies():
+    # replace(**PRODUCTION_PERF_KNOBS) doubles as a guard that every knob
+    # name stays a real FiraConfig field
+    cfg = _cfg(["train", "--perf", "production"])
+    for k, v in PRODUCTION_PERF_KNOBS.items():
+        assert getattr(cfg, k) == v, k
+
+
+def test_explicit_flag_overrides_preset():
+    cfg = _cfg(["train", "--perf", "production", "--rng-impl", "threefry"])
+    assert cfg.rng_impl == "threefry"
+    assert cfg.fused_steps == PRODUCTION_PERF_KNOBS["fused_steps"]
+
+
+def test_accum_request_drops_preset_fused_loop():
+    # fused_steps>1 and accum_steps>1 are mutually exclusive by config
+    # contract; an explicit --accum-steps must win over the preset
+    cfg = _cfg(["train", "--perf", "production", "--accum-steps", "4"])
+    assert cfg.accum_steps == 4
+    assert cfg.fused_steps == 1
+    # ...unless the user pins both (then the config's own validation speaks)
+    cfg = _cfg(["train", "--perf", "production", "--accum-steps", "1"])
+    assert cfg.fused_steps == PRODUCTION_PERF_KNOBS["fused_steps"]
+
+
+def test_train_then_test_end_to_end(tmp_path):
+    """The reference workflow (README.md:29,35): train writes a best
+    checkpoint + train_process log, test beam-decodes OUTPUT/output_fira."""
+    data = str(tmp_path / "DataSet")
+    out = str(tmp_path / "OUTPUT")
+    rc = cli.main(["train", "--config", "fira-tiny", "--synthetic", "24",
+                   "--epochs", "2", "--data-dir", data, "--out-dir", out])
+    assert rc == 0
+    assert os.path.exists(os.path.join(out, "train_process"))
+    rc = cli.main(["test", "--config", "fira-tiny",
+                   "--data-dir", data, "--out-dir", out])
+    assert rc == 0
+    out_file = os.path.join(out, "output_fira")
+    assert os.path.exists(out_file)
+    with open(out_file) as f:
+        lines = f.read().splitlines()
+    # one prediction line per test-split commit
+    from fira_tpu.data.dataset import FiraDataset
+
+    args = cli.build_parser().parse_args(
+        ["test", "--config", "fira-tiny", "--data-dir", data])
+    ds = FiraDataset(data, cli._resolve_cfg(args))
+    assert len(lines) == len(ds.splits["test"])
+
+
+def test_train_production_preset_tiny(tmp_path):
+    """The production knob set trains end-to-end (fused device loop + rbg
+    dropout + sorted bf16 wire... on CPU the dtype stays f32 but the code
+    paths are the production ones)."""
+    data = str(tmp_path / "DataSet")
+    out = str(tmp_path / "OUTPUT")
+    rc = cli.main(["train", "--config", "fira-tiny", "--synthetic", "24",
+                   "--epochs", "1", "--perf", "production",
+                   "--data-dir", data, "--out-dir", out])
+    assert rc == 0
