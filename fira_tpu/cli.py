@@ -61,6 +61,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "each_num=100)")
     p.add_argument("--num-procs", type=int, default=None,
                    help="preprocess: worker processes (default: cpu count)")
+    p.add_argument("--encoder-buffer", default=None,
+                   choices=["single", "split"],
+                   help="encoder node buffer: one 650-row tensor with "
+                        "per-round update-slices (single, default) or two "
+                        "persistent segments with column-slab A.x bmms "
+                        "(split; dense adjacency only, equal up to matmul "
+                        "reassociation)")
     p.add_argument("--adjacency", default=None,
                    choices=["dense", "segment"],
                    help="GCN message passing: dense bmm (default) or "
@@ -125,6 +132,8 @@ def _resolve_cfg(args):
         overrides["beam_compat_prob_space"] = False
     if args.adjacency:
         overrides["adjacency_impl"] = args.adjacency
+    if args.encoder_buffer:
+        overrides["encoder_buffer"] = args.encoder_buffer
     if args.copy_head:
         overrides["copy_head_impl"] = args.copy_head
     if args.seq_shards is not None:

@@ -71,6 +71,18 @@ class FiraConfig:
     # scatters without its sorting prologue). Semantically a no-op —
     # scatter-add order is irrelevant; equality is pinned by tests.
     sort_edges: bool = False
+    # "single": one persistent (B, graph_len, d) encoder node buffer; each
+    #   round static-update-slices the Combination rows in place. "split":
+    #   the diff rows and the [sub||ast] rows live as two tensors for the
+    #   whole stack and the GCN's A.x runs as two column-slab bmms
+    #   (A[:,:,:sou] @ top + A[:,:,sou:] @ rest — same FLOPs; the two
+    #   adjacency slabs are loop-invariant so XLA hoists them once) — no
+    #   650-row buffer update ever materializes (the update-slice's
+    #   (B,650,256) copy pairs are the largest single item in the round-4
+    #   per-op trace, docs/TPU_OP_TIMES.json). Split sums the bmm in two
+    #   parts, so outputs match "single" to matmul reassociation tolerance,
+    #   not bitwise; dense adjacency only.
+    encoder_buffer: str = "single"
     # "xla": pointer scores materialize the (B,T,S,D) tanh intermediate;
     # "pallas": fused kernel streams it through VMEM (ops/copy_score.py) —
     #   same math, no HBM intermediate (runs interpreted off-TPU).

@@ -170,16 +170,37 @@ class GCN(nn.Module):
 
     @nn.compact
     def __call__(self, graph_em, adj, *, deterministic: bool):
-        x = TorchDense(self.d_model, dtype=self.dtype, name="fc1")(graph_em)
+        fc1 = TorchDense(self.d_model, dtype=self.dtype, name="fc1")
+        fc2 = TorchDense(self.d_model, dtype=self.dtype, name="fc2")
+        drop = nn.Dropout(self.dropout_rate)
+        norm = nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype),
+                            name="norm")
+        if isinstance(graph_em, tuple):
+            # split-buffer mode (cfg.encoder_buffer="split"): the node
+            # buffer never exists as one tensor — fc1/fc2/norm are the SAME
+            # parameters applied per segment, A.x runs as two column-slab
+            # bmms, and the single full-width dropout call keeps the RNG
+            # stream identical to the single-buffer path. Outputs match
+            # "single" to matmul-reassociation tolerance (two partial sums
+            # instead of one 650-long contraction).
+            top, rest = graph_em
+            adj_top, adj_rest = adj
+            s = top.shape[1]
+            x = (jnp.einsum("bij,bjd->bid", adj_top.astype(self.dtype),
+                            fc1(top))
+                 + jnp.einsum("bij,bjd->bid", adj_rest.astype(self.dtype),
+                              fc1(rest)))
+            x = drop(fc2(x), deterministic=deterministic)
+            y_top = residual_out(norm(x[:, :s] + top), self.residual_dtype)
+            y_rest = residual_out(norm(x[:, s:] + rest), self.residual_dtype)
+            return y_top, y_rest
+        x = fc1(graph_em)
         if callable(adj):  # COO message-passing path (model.coo_matvec)
             x = adj(x)
         else:
             x = jnp.einsum("bij,bjd->bid", adj.astype(self.dtype), x)
-        x = TorchDense(self.d_model, dtype=self.dtype, name="fc2")(x)
-        x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
-        return residual_out(
-            nn.LayerNorm(epsilon=1e-5, dtype=stable_dtype(self.dtype),
-                         name="norm")(x + graph_em), self.residual_dtype)
+        x = drop(fc2(x), deterministic=deterministic)
+        return residual_out(norm(x + graph_em), self.residual_dtype)
 
 
 class Attention(nn.Module):

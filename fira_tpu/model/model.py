@@ -144,29 +144,50 @@ class Encoder(nn.Module):
         # and re-concatenates every round (gnn_transformer.py:46-58) — six
         # (B, 650, 256) relayout copies per step that a static update-slice
         # never materializes. Same values, same parameter tree.
-        graph_em = jnp.concatenate([input_em, sub_token_em, ast_change_em],
-                                   axis=1)
+        if cfg.encoder_buffer not in ("single", "split"):
+            raise ValueError(
+                f"unknown encoder_buffer {cfg.encoder_buffer!r}; "
+                f"choose 'single' or 'split'")
+        split = cfg.encoder_buffer == "split"
+        if split and callable(adj):
+            raise ValueError(
+                "encoder_buffer='split' needs the dense adjacency (its A.x "
+                "runs as two column slabs); use adjacency_impl='dense'")
+        if split:
+            top = input_em
+            rest = jnp.concatenate([sub_token_em, ast_change_em], axis=1)
+            # loop-invariant column slabs: sliced once, reused by all rounds
+            adj = (adj[:, :, : cfg.sou_len], adj[:, :, cfg.sou_len :])
+            graph_em = (top, rest)
+        else:
+            graph_em = jnp.concatenate(
+                [input_em, sub_token_em, ast_change_em], axis=1)
         for i in range(cfg.num_layers):
-            input_em = graph_em[:, : cfg.sou_len]
+            input_em = graph_em[0] if split else graph_em[:, : cfg.sou_len]
             input_em = Combination(
                 num_heads=cfg.num_head, d_model=cfg.embedding_dim,
                 dropout_rate=cfg.dropout_rate, dtype=self.dtype,
                 residual_dtype=self._residual_dtype(),
                 name=f"combination_{i}",
             )(input_em, input_em, mark_em, deterministic=deterministic)
-            # dynamic_update_slice does not promote dtypes the way the old
+            # the buffer update does not promote dtypes the way the old
             # concatenate did: round 0's buffer is the compute dtype while
             # the Combination's post-LN output is the stable dtype — cast
             # the update (f32/f64: no-op; bf16: affects only round 0's GCN
             # residual precision, the fc1 input is cast either way)
-            graph_em = jax.lax.dynamic_update_slice_in_dim(
-                graph_em, input_em.astype(graph_em.dtype), 0, axis=1)
+            if split:
+                graph_em = (input_em.astype(graph_em[1].dtype), graph_em[1])
+            else:
+                graph_em = jax.lax.dynamic_update_slice_in_dim(
+                    graph_em, input_em.astype(graph_em.dtype), 0, axis=1)
             graph_em = GCN(
                 d_model=cfg.embedding_dim, dropout_rate=cfg.gcn_dropout_rate,
                 dtype=self.dtype, residual_dtype=self._residual_dtype(),
                 name=f"gcn_{i}",
             )(graph_em, adj, deterministic=deterministic)
 
+        if split:
+            return graph_em[0], graph_em[1][:, : cfg.sub_token_len]
         return (graph_em[:, : cfg.sou_len],
                 graph_em[:, cfg.sou_len : cfg.sou_len + cfg.sub_token_len])
 

@@ -122,6 +122,10 @@ maybe("stacked", rng_impl="rbg", fused=8, sort_edges=True,
       stable_residual=False, copy_head_remat=False)
 maybe("stacked_b340", rng_impl="rbg", fused=4, sort_edges=True,
       stable_residual=False, copy_head_remat=False, batch=340)
+# round-4 second wave: split encoder buffer (no per-round update-slice)
+maybe("split_buffer", encoder_buffer="split")
+maybe("stacked_split", rng_impl="rbg", fused=8, sort_edges=True,
+      stable_residual=False, copy_head_remat=False, encoder_buffer="split")
 
 if _only is not None and _only - _ran:
     # a typo'd tag silently measuring nothing would waste a TPU window

@@ -20,8 +20,8 @@ for i in $(seq 1 200); do
       timeout 1400 python "$job" >> "$LOG" 2>&1
       echo "[roundup] $job rc=$? $(date -u +%FT%TZ)" >> "$LOG"
     done
-    echo "[roundup] running ablate2 base,stacked $(date -u +%FT%TZ)" >> "$LOG"
-    FIRA_ABLATE2_ONLY=base,stacked timeout 1400 python scripts/tpu_ablate2.py >> "$LOG" 2>&1
+    echo "[roundup] running ablate2 subset $(date -u +%FT%TZ)" >> "$LOG"
+    FIRA_ABLATE2_ONLY=base,stacked,split_buffer,stacked_split timeout 1400 python scripts/tpu_ablate2.py >> "$LOG" 2>&1
     echo "[roundup] ablate2 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
     echo "[roundup] running bench.py $(date -u +%FT%TZ)" >> "$LOG"
     FIRA_BENCH_PROBE_BUDGET=120 timeout 1200 python bench.py >> "$LOG" 2>&1
