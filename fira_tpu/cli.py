@@ -43,6 +43,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--epochs", type=int, default=None,
                    help="override config epoch count")
     p.add_argument("--batch-size", type=int, default=None)
+    def _positive(s):
+        v = int(s)
+        if v < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+        return v
+
+    p.add_argument("--test-batch-size", type=_positive, default=None,
+                   metavar="N",
+                   help="test: decode batch (default 20, the reference's "
+                        "run_model.py:41). A pure throughput knob: "
+                        "predictions are batch-invariant (tested), and the "
+                        "decode step's per-sample matmuls under-fill the "
+                        "MXU at small batches")
     p.add_argument("--no-resume", action="store_true",
                    help="ignore an existing latest checkpoint")
     p.add_argument("--synthetic", type=int, default=None, metavar="N",
@@ -124,6 +137,8 @@ def _resolve_cfg(args):
     overrides = {}
     if args.batch_size:
         overrides["batch_size"] = args.batch_size
+    if args.test_batch_size:
+        overrides["test_batch_size"] = args.test_batch_size
     if args.epochs:
         overrides["epochs"] = args.epochs
     if args.dtype:

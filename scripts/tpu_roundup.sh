@@ -23,6 +23,9 @@ for i in $(seq 1 200); do
     echo "[roundup] running ablate2 subset $(date -u +%FT%TZ)" >> "$LOG"
     FIRA_ABLATE2_ONLY=base,stacked,split_buffer,stacked_split timeout 1400 python scripts/tpu_ablate2.py >> "$LOG" 2>&1
     echo "[roundup] ablate2 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    echo "[roundup] running decode bench batch512 $(date -u +%FT%TZ)" >> "$LOG"
+    DECODE_BATCH=512 timeout 1400 python scripts/tpu_decode_bench.py >> "$LOG" 2>&1
+    echo "[roundup] decode512 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
     echo "[roundup] running bench.py $(date -u +%FT%TZ)" >> "$LOG"
     FIRA_BENCH_PROBE_BUDGET=120 timeout 1200 python bench.py >> "$LOG" 2>&1
     echo "[roundup] bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"

@@ -86,3 +86,27 @@ def test_train_production_preset_tiny(tmp_path):
                    "--epochs", "1", "--perf", "production",
                    "--data-dir", data, "--out-dir", out])
     assert rc == 0
+
+
+def test_decode_is_batch_size_invariant(tmp_path):
+    """--test-batch-size is a pure throughput knob: per-sample beam search
+    is independent and pad rows are valid-masked, so the written
+    predictions must not change with the decode batch."""
+    data = str(tmp_path / "DataSet")
+    out1 = str(tmp_path / "OUT_A")
+    out2 = str(tmp_path / "OUT_B")
+    rc = cli.main(["train", "--config", "fira-tiny", "--synthetic", "24",
+                   "--epochs", "1", "--data-dir", data, "--out-dir", out1])
+    assert rc == 0
+    # same checkpoint, two decode batch sizes
+    ck = os.path.join(out1, "ckpt")
+    for out, tbs in ((out1, "2"), (out2, "5")):
+        rc = cli.main(["test", "--config", "fira-tiny", "--data-dir", data,
+                       "--out-dir", out, "--ckpt-dir", ck,
+                       "--test-batch-size", tbs])
+        assert rc == 0
+    with open(os.path.join(out1, "output_fira")) as f:
+        a = f.read()
+    with open(os.path.join(out2, "output_fira")) as f:
+        b = f.read()
+    assert a == b
