@@ -23,6 +23,23 @@ from fira_tpu.data.dataset import ProcessedSplit, ARRAY_FIELDS
 Batch = Dict[str, np.ndarray]
 
 
+def sort_edge_rows(senders, receivers, values, kinds, graph_len: int):
+    """Row-wise sort of padded COO fields by linear cell index -> the
+    device scatter's index stream is globally sorted (rows ascend, cells
+    ascend within a row); pads (0,0,value 0) land first and still add
+    nothing. ALL per-edge fields must ride the same permutation — kinds
+    included when the typed-edge extension ships them."""
+    order = np.argsort(
+        senders.astype(np.int32) * graph_len + receivers, axis=1,
+        kind="stable")
+    senders = np.take_along_axis(senders, order, axis=1)
+    receivers = np.take_along_axis(receivers, order, axis=1)
+    values = np.take_along_axis(values, order, axis=1)
+    if kinds is not None:
+        kinds = np.take_along_axis(kinds, order, axis=1)
+    return senders, receivers, values, kinds
+
+
 def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
                batch_size: Optional[int] = None) -> Batch:
     """Gather + pad a batch. ``indices`` may be shorter than batch_size."""
@@ -88,17 +105,8 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
         if kinds is not None:
             kinds[row, :n] = split.arrays["edge_kinds"][lo:hi]
     if cfg.sort_edges:
-        # row-wise sort by linear cell index -> the device scatter's index
-        # stream is globally sorted (rows ascend, cells ascend within a
-        # row); pads (0,0,value 0) land first and still add nothing
-        order = np.argsort(
-            senders.astype(np.int32) * cfg.graph_len + receivers, axis=1,
-            kind="stable")
-        senders = np.take_along_axis(senders, order, axis=1)
-        receivers = np.take_along_axis(receivers, order, axis=1)
-        values = np.take_along_axis(values, order, axis=1)
-        if kinds is not None:
-            kinds = np.take_along_axis(kinds, order, axis=1)
+        senders, receivers, values, kinds = sort_edge_rows(
+            senders, receivers, values, kinds, cfg.graph_len)
 
     batch["senders"] = senders
     batch["receivers"] = receivers

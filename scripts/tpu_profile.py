@@ -40,6 +40,14 @@ if os.environ.get("PROFILE_CPU") == "1":
     force_cpu_backend()
 
 cfg = fira_full(batch_size=BATCH, compute_dtype="bfloat16")
+# PROFILE_OVERRIDES: JSON FiraConfig fields, e.g. the production knob set
+# (minus fused_steps — this script profiles the single-step program):
+#   PROFILE_OVERRIDES='{"rng_impl":"rbg","sort_edges":true,
+#                       "stable_residual":false,"copy_head_remat":false,
+#                       "encoder_buffer":"split"}'
+_over = json.loads(os.environ.get("PROFILE_OVERRIDES", "{}"))
+if _over:
+    cfg = cfg.replace(**_over)
 cfg, split, _ = make_memory_split(cfg, 256, seed=0,
                                   pad_vocab_to=24650, pad_ast_vocab_to=71)
 rng = np.random.RandomState(0)
@@ -100,16 +108,26 @@ for plane in space.planes:
                     for n, (ps, c) in top],
     })
 
+report = {"config_overrides": _over, "batch": BATCH,
+          "cpu_backend": os.environ.get("PROFILE_CPU") == "1",
+          "planes": report}
 out = os.path.join(TRACE_DIR, "op_times.json")
 with open(out, "w") as f:
     json.dump(report, f, indent=1)
-# the aggregated table is the committable evidence (the raw xplane trace is
-# tens of MB of /tmp); land it in docs/ so a watchdog harvest gets committed
-repo_out = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "docs", "TPU_OP_TIMES.json")
-with open(repo_out, "w") as f:
-    json.dump(report, f, indent=1)
-for plane in report:
+# The aggregated table is the committable evidence (the raw xplane trace is
+# tens of MB of /tmp); land it in docs/ so a watchdog harvest gets
+# committed. Provenance rules: the parity-default TPU capture owns
+# TPU_OP_TIMES.json, an overridden config gets its own file, and a CPU
+# capture never overwrites TPU evidence.
+if not report["cpu_backend"]:
+    name = ("TPU_OP_TIMES.json" if not _over
+            else "TPU_OP_TIMES_" + "_".join(
+                sorted(str(k) for k in _over)) + ".json")
+    repo_out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", name)
+    with open(repo_out, "w") as f:
+        json.dump(report, f, indent=1)
+for plane in report["planes"]:
     print(json.dumps({"plane": plane["plane"], "total_ms": plane["total_ms"],
                       "top5": plane["top_ops"][:5]}), flush=True)
 print(json.dumps({"path": out}), flush=True)

@@ -89,10 +89,16 @@ def test_mesh_train_step_and_tp_shardings(tiny_setup):
     assert l1 < l0
 
 
-def test_mesh_matches_single_device_loss(tiny_setup):
+@pytest.mark.parametrize("overrides", [
+    {},  # parity defaults
+    # production-config encoder: split buffer + sorted scatter — guards the
+    # column-slab einsums' sharding propagation under the Megatron TP rules
+    {"encoder_buffer": "split", "sort_edges": True},
+], ids=["parity", "split_buffer"])
+def test_mesh_matches_single_device_loss(tiny_setup, overrides):
     """DP+TP sharded step computes the same loss as the unsharded step."""
     dataset = tiny_setup
-    cfg = dataset.cfg
+    cfg = dataset.cfg.replace(**overrides)
     model = FiraModel(cfg)
     split = dataset.splits["train"]
     batch = make_batch(split, np.arange(cfg.batch_size), cfg)
