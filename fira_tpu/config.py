@@ -71,6 +71,12 @@ class FiraConfig:
     # scatters without its sorting prologue). Semantically a no-op —
     # scatter-add order is irrelevant; equality is pinned by tests.
     sort_edges: bool = False
+    # Lower the dense-adjacency build as ONE linearized 1-D scatter
+    # (flat = (b*N+s)*N+r) instead of the batched 3-D scatter. With
+    # sort_edges the flat stream is fully ascending, the friendliest index
+    # pattern XLA can be promised. Bit-identical output (pinned by tests);
+    # a measured perf candidate, dense path only.
+    flat_scatter: bool = False
     # "single": one persistent (B, graph_len, d) encoder node buffer; each
     #   round static-update-slices the Combination rows in place. "split":
     #   the diff rows and the [sub||ast] rows live as two tensors for the

@@ -122,10 +122,16 @@ maybe("stacked", rng_impl="rbg", fused=8, sort_edges=True,
       stable_residual=False, copy_head_remat=False)
 maybe("stacked_b340", rng_impl="rbg", fused=4, sort_edges=True,
       stable_residual=False, copy_head_remat=False, batch=340)
-# round-4 second wave: split encoder buffer (no per-round update-slice)
+# round-4 second wave: split encoder buffer (no per-round update-slice),
+# flat 1-D adjacency scatter (fully-ascending stream under sort_edges)
 maybe("split_buffer", encoder_buffer="split")
 maybe("stacked_split", rng_impl="rbg", fused=8, sort_edges=True,
       stable_residual=False, copy_head_remat=False, encoder_buffer="split")
+maybe("stacked_flat", rng_impl="rbg", fused=8, sort_edges=True,
+      stable_residual=False, copy_head_remat=False, flat_scatter=True)
+maybe("stacked_split_flat", rng_impl="rbg", fused=8, sort_edges=True,
+      stable_residual=False, copy_head_remat=False, encoder_buffer="split",
+      flat_scatter=True)
 
 if _only is not None and _only - _ran:
     # a typo'd tag silently measuring nothing would waste a TPU window

@@ -120,9 +120,10 @@ with open(out, "w") as f:
 # TPU_OP_TIMES.json, an overridden config gets its own file, and a CPU
 # capture never overwrites TPU evidence.
 if not report["cpu_backend"]:
+    slug = "_".join(f"{k}-{_over[k]}" for k in sorted(_over))
+    slug = "".join(c if c.isalnum() or c in "-_" else "-" for c in slug)
     name = ("TPU_OP_TIMES.json" if not _over
-            else "TPU_OP_TIMES_" + "_".join(
-                sorted(str(k) for k in _over)) + ".json")
+            else f"TPU_OP_TIMES_{slug}.json")
     repo_out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", name)
     with open(repo_out, "w") as f:

@@ -21,7 +21,7 @@ for i in $(seq 1 200); do
       echo "[roundup] $job rc=$? $(date -u +%FT%TZ)" >> "$LOG"
     done
     echo "[roundup] running ablate2 subset $(date -u +%FT%TZ)" >> "$LOG"
-    FIRA_ABLATE2_ONLY=base,stacked,split_buffer,stacked_split timeout 1400 python scripts/tpu_ablate2.py >> "$LOG" 2>&1
+    FIRA_ABLATE2_ONLY=base,stacked,split_buffer,stacked_split,stacked_flat,stacked_split_flat timeout 2000 python scripts/tpu_ablate2.py >> "$LOG" 2>&1
     echo "[roundup] ablate2 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
     echo "[roundup] running production per-op profile $(date -u +%FT%TZ)" >> "$LOG"
     PROFILE_DIR=/tmp/fira_tpu_trace_prod PROFILE_OVERRIDES='{"rng_impl":"rbg","sort_edges":true,"stable_residual":false,"copy_head_remat":false,"encoder_buffer":"split"}' timeout 1400 python scripts/tpu_profile.py >> "$LOG" 2>&1

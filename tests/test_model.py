@@ -175,3 +175,39 @@ class TestSplitEncoderBuffer:
                                       adjacency_impl="segment")
         with pytest.raises(ValueError, match="dense adjacency"):
             FiraModel(cfg_bad).apply(params, jbatch, deterministic=True)
+
+
+def test_flat_scatter_is_bit_identical(tiny):
+    """cfg.flat_scatter lowers the dense adjacency as one linearized 1-D
+    scatter — same cells, same adds, bitwise-equal output (sorted and
+    unsorted streams, f32 and bf16 targets)."""
+    from fira_tpu.data.batching import sort_edge_rows
+
+    cfg, _model, _params, jbatch = tiny
+    s_np = np.asarray(jbatch["senders"])
+    r_np = np.asarray(jbatch["receivers"])
+    v_np = np.asarray(jbatch["values"])
+    ss, rs, vs, _ = sort_edge_rows(s_np, r_np, v_np, None, cfg.graph_len)
+    streams = [(s_np, r_np, v_np, False),  # raw order, no sorted promise
+               (ss, rs, vs, True)]         # host-sorted, promise honored
+    for out_dtype in (jnp.float32, jnp.bfloat16):
+        for s, r, v, sorted_flag in streams:
+            a = dense_adjacency(jnp.asarray(s), jnp.asarray(r),
+                                jnp.asarray(v), cfg.graph_len,
+                                indices_sorted=sorted_flag,
+                                out_dtype=out_dtype)
+            b = dense_adjacency(jnp.asarray(s), jnp.asarray(r),
+                                jnp.asarray(v), cfg.graph_len,
+                                indices_sorted=sorted_flag,
+                                out_dtype=out_dtype, flat=True)
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_flat_scatter_segment_path_is_rejected(tiny):
+    import dataclasses
+
+    cfg, _model, params, jbatch = tiny
+    cfg_bad = dataclasses.replace(cfg, adjacency_impl="segment",
+                                  flat_scatter=True)
+    with pytest.raises(ValueError, match="dense"):
+        FiraModel(cfg_bad).apply(params, jbatch, deterministic=True)
