@@ -66,6 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "devices on the data axis")
     p.add_argument("--dtype", default=None, choices=["float32", "bfloat16"],
                    help="compute dtype override (params stay f32)")
+    p.add_argument("--beam-factored-topk", action="store_true",
+                   help="test: beam candidates from per-side top-ks "
+                        "(generation vocab + copy positions, gate-scaled) "
+                        "instead of the assembled 25,020-way fused tensor "
+                        "— token-exact (pinned by tests)")
     p.add_argument("--beam-log-space", action="store_true",
                    help="log-space beam accumulation instead of the "
                         "reference-compat probability space")
@@ -145,6 +150,8 @@ def _resolve_cfg(args):
         overrides["compute_dtype"] = args.dtype
     if args.beam_log_space:
         overrides["beam_compat_prob_space"] = False
+    if args.beam_factored_topk:
+        overrides["beam_factored_topk"] = True
     if args.adjacency:
         overrides["adjacency_impl"] = args.adjacency
     if args.encoder_buffer:

@@ -115,6 +115,16 @@ class FiraConfig:
     beam_compat_prob_space: bool = True  # reference prob-space accumulation
                                          # (run_model.py:271,305); False => log-space
     beam_kv_cache: bool = True  # O(T) cached decode vs full-prefix re-decode
+    # Beam candidate selection from the distribution FACTORS: per-side
+    # top-k over the generation softmax (vocab) and the copy softmax
+    # (sou+sub positions), gate-scaled and merged — 2k candidates per beam
+    # instead of a top-k over the assembled 25,020-way fused tensor. Exact
+    # for the top-k VALUES (the fused dist is the two sides scaled by their
+    # gate weights, so any global top-k entry is inside a side's top-k);
+    # ties between exactly-equal probabilities may break differently than
+    # the fused scan order, which is why this is a knob and the
+    # token-equality pins ride the test fixtures.
+    beam_factored_topk: bool = False
 
     # --- typed edges (beyond-parity extension) ---
     # The reference computes six edge families then flattens them into one

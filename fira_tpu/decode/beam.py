@@ -76,6 +76,71 @@ def _init_beam(B: int, cfg: FiraConfig):
     return tokens0, probs0, finished0, neg
 
 
+def _selection_tail(cand, ids, tokens, probs, finished, s, batch,
+                    cfg: FiraConfig, neg):
+    """Shared selection tail for :func:`_select` and
+    :func:`_select_factored`: mask finished beams, append their sentinel
+    entries, one global top-k over K*W + K candidates, decode sentinels vs
+    real candidates, write the chosen token at position s+1
+    (run_model.py:267-310).
+
+    cand: (B, K, W) candidate scores already in the selection space.
+    ids: None when W is the fused output space itself (token id = index
+    within the beam's W); else a (B, K, W) table of fused-space ids to
+    gather the chosen token from (the factored path's per-side top-k
+    candidates)."""
+    B, K, W = cand.shape
+    cand = jnp.where(finished[:, :, None], neg, cand)
+    sentinel = jnp.where(finished, probs, neg)          # (B, K)
+    allc = jnp.concatenate([cand.reshape(B, K * W), sentinel], axis=1)
+    top_vals, top_idx = jax.lax.top_k(allc, K)          # (B, K)
+
+    is_sent = top_idx >= K * W
+    src_beam = jnp.where(is_sent, top_idx - K * W, top_idx // W)
+    if ids is None:
+        tok = jnp.where(is_sent, 0, top_idx % W)
+    else:
+        tok = jnp.take_along_axis(
+            ids.reshape(B, K * W), jnp.where(is_sent, 0, top_idx), axis=1)
+        tok = jnp.where(is_sent, 0, tok)
+    tok = _resolve_copy(tok, batch["diff"], batch["sub_token"], cfg)
+
+    new_tokens = jnp.take_along_axis(tokens, src_beam[:, :, None], axis=1)
+    keep = new_tokens[:, :, s + 1]  # finished beams keep their padding
+    new_tokens = new_tokens.at[:, :, s + 1].set(
+        jnp.where(is_sent, keep, tok)
+    )
+    new_finished = jnp.where(is_sent, True, tok == EOS_ID)
+    return new_tokens, top_vals, new_finished, src_beam
+
+
+def _select_factored(gen, copy, gate, tokens, probs, finished, s, batch,
+                     cfg: FiraConfig, neg):
+    """Beam-selection round from the distribution FACTORS.
+
+    gen: (B, K, vocab) generation softmax; copy: (B, K, sou+sub) copy
+    softmax; gate: (B, K, 2). The fused distribution is
+    [gate0*gen || gate1*copy], so each beam's global top-K lies in the
+    union of its per-side top-Ks — selection runs over 2K candidates per
+    beam (6 for beam 3) instead of the 25,020-way assembled tensor. Same
+    candidate math as :func:`_select` (prob- or log-space, finished-beam
+    sentinels); only tie-breaking among exactly-equal probabilities can
+    differ from the fused scan order."""
+    B, K, V = gen.shape
+    gv, gi = jax.lax.top_k(gen, K)                      # (B, K, K)
+    cv, ci = jax.lax.top_k(copy, K)
+    side_vals = jnp.concatenate(
+        [gv * gate[:, :, 0:1], cv * gate[:, :, 1:2]], axis=-1)  # (B, K, 2K)
+    side_ids = jnp.concatenate([gi, ci + V], axis=-1)   # fused-space ids
+
+    if cfg.beam_compat_prob_space:
+        cand = side_vals * probs[:, :, None]
+    else:
+        cand = jnp.log(jnp.clip(side_vals, 1e-10, 1.0)) + probs[:, :, None]
+    return _selection_tail(cand, side_ids, tokens, probs, finished, s,
+                           batch, cfg, neg)
+
+
 def _select(dist, tokens, probs, finished, s, batch, cfg: FiraConfig, neg):
     """One beam-selection round given this step's fused distribution.
 
@@ -86,28 +151,12 @@ def _select(dist, tokens, probs, finished, s, batch, cfg: FiraConfig, neg):
     probability; one global top-k over K*V_out + K candidates
     (run_model.py:267-310). Returns (new_tokens, new_probs, new_finished,
     src_beam)."""
-    B, K, V_out = dist.shape
     if cfg.beam_compat_prob_space:
         cand = dist * probs[:, :, None]
     else:
         cand = jnp.log(jnp.clip(dist, 1e-10, 1.0)) + probs[:, :, None]
-    cand = jnp.where(finished[:, :, None], neg, cand)
-    sentinel = jnp.where(finished, probs, neg)          # (B, K)
-    allc = jnp.concatenate([cand.reshape(B, K * V_out), sentinel], axis=1)
-    top_vals, top_idx = jax.lax.top_k(allc, K)          # (B, K)
-
-    is_sent = top_idx >= K * V_out
-    src_beam = jnp.where(is_sent, top_idx - K * V_out, top_idx // V_out)
-    tok = jnp.where(is_sent, 0, top_idx % V_out)
-    tok = _resolve_copy(tok, batch["diff"], batch["sub_token"], cfg)
-
-    new_tokens = jnp.take_along_axis(tokens, src_beam[:, :, None], axis=1)
-    keep = new_tokens[:, :, s + 1]  # finished beams keep their padding
-    new_tokens = new_tokens.at[:, :, s + 1].set(
-        jnp.where(is_sent, keep, tok)
-    )
-    new_finished = jnp.where(is_sent, True, tok == EOS_ID)
-    return new_tokens, top_vals, new_finished, src_beam
+    return _selection_tail(cand, None, tokens, probs, finished, s,
+                           batch, cfg, neg)
 
 
 def beam_search(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
@@ -137,6 +186,17 @@ def beam_search(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
         # they are masked out of selection anyway)
         tar_mask = flat != 0
         tar_mask = tar_mask.at[:, 0].set(True)  # position 0 is <start>: always attended
+        if cfg.beam_factored_topk:
+            gen, copy, gate = model.apply(
+                {"params": params}, states_k, mask_k, flat, tar_mask,
+                method=FiraModel.dist_parts,
+            )
+            new_tokens, new_probs, new_finished, _ = _select_factored(
+                gen[:, s, :].reshape(B, K, -1),
+                copy[:, s, :].reshape(B, K, -1),
+                gate[:, s, :].reshape(B, K, 2),
+                tokens, probs, finished, s, batch, cfg, neg)
+            return (new_tokens, new_probs, new_finished), None
         fused = model.apply(
             {"params": params}, states_k, mask_k, flat, tar_mask,
             method=FiraModel.fused_probs,
@@ -192,15 +252,28 @@ def beam_search_cached(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
         # mask, restricted causally to positions <= s
         valid = (flat != 0).at[:, 0].set(True) & (jnp.arange(T)[None, :] <= s)
         tok_in = jax.lax.dynamic_slice_in_dim(flat, s, 1, axis=1)  # (B*K, 1)
-        fused, k_cache, v_cache = model.apply(
-            {"params": params}, mask_k, tok_in, s,
-            k_cache, v_cache, cross_k, cross_v, src_proj,
-            valid[:, None, None, :],
-            method=FiraModel.fused_probs_step,
-        )  # (B*K, 1, V_out)
-        dist = fused[:, 0, :].reshape(B, K, V_out)
-        new_tokens, new_probs, new_finished, src_beam = _select(
-            dist, tokens, probs, finished, s, batch, cfg, neg)
+        if cfg.beam_factored_topk:
+            gen, copy, gate, k_cache, v_cache = model.apply(
+                {"params": params}, mask_k, tok_in, s,
+                k_cache, v_cache, cross_k, cross_v, src_proj,
+                valid[:, None, None, :],
+                method=FiraModel.dist_parts_step,
+            )
+            new_tokens, new_probs, new_finished, src_beam = _select_factored(
+                gen[:, 0, :].reshape(B, K, -1),
+                copy[:, 0, :].reshape(B, K, -1),
+                gate[:, 0, :].reshape(B, K, 2),
+                tokens, probs, finished, s, batch, cfg, neg)
+        else:
+            fused, k_cache, v_cache = model.apply(
+                {"params": params}, mask_k, tok_in, s,
+                k_cache, v_cache, cross_k, cross_v, src_proj,
+                valid[:, None, None, :],
+                method=FiraModel.fused_probs_step,
+            )  # (B*K, 1, V_out)
+            dist = fused[:, 0, :].reshape(B, K, V_out)
+            new_tokens, new_probs, new_finished, src_beam = _select(
+                dist, tokens, probs, finished, s, batch, cfg, neg)
         # permute cached histories to follow their beams: (L, B, K, ...)
         idx = src_beam[None, :, :, None, None, None]
 

@@ -505,12 +505,21 @@ class FiraModel(nn.Module):
         cross_k, cross_v = self.decoder.cross_kv(states)
         return cross_k, cross_v, self.copy_net.project_src(states)
 
-    def fused_probs_step(self, mask, tok, pos_idx, k_cache, v_cache,
-                         cross_k, cross_v, src_proj, self_mask):
-        """One-position fused distribution with KV caching: same math as
-        slicing position ``pos_idx`` out of :meth:`fused_probs`, at O(1)
-        decoder cost per step instead of O(tar_len). Returns
-        (fused (B, 1, V_out), k_cache, v_cache)."""
+    def dist_parts(self, states, mask, tar, tar_mask_pad, *,
+                   deterministic: bool = True):
+        """Public factor view for the factored beam (cfg.beam_factored_topk):
+        (gen, copy, gate) with no fused assembly — see :meth:`_dist_parts`."""
+        return self._dist_parts(states, mask, tar, tar_mask_pad,
+                                deterministic=deterministic)
+
+    def dist_parts_step(self, mask, tok, pos_idx, k_cache, v_cache,
+                        cross_k, cross_v, src_proj, self_mask):
+        """One-position distribution FACTORS with KV caching: the
+        (gen, copy, gate) triple of :meth:`fused_probs_step` without the
+        fused concatenation/gate products. The factored beam takes per-side
+        top-k from these directly (the fused distribution is the two sides
+        scaled by their gate weights, so the global top-k lives in the
+        union of the per-side top-ks)."""
         tar_emb, k_cache, v_cache = self.decoder.decode_step(
             tok, pos_idx, k_cache, v_cache, cross_k, cross_v, mask, self_mask,
         )
@@ -521,6 +530,17 @@ class FiraModel(nn.Module):
         scores = jnp.where(mask[:, None, :], scores,
                            jnp.asarray(-1e9, scores.dtype))
         copy = jax.nn.softmax(scores.astype(stable_dtype(self.dtype)), axis=-1)
+        return gen, copy, gate, k_cache, v_cache
+
+    def fused_probs_step(self, mask, tok, pos_idx, k_cache, v_cache,
+                         cross_k, cross_v, src_proj, self_mask):
+        """One-position fused distribution with KV caching: same math as
+        slicing position ``pos_idx`` out of :meth:`fused_probs`, at O(1)
+        decoder cost per step instead of O(tar_len). Returns
+        (fused (B, 1, V_out), k_cache, v_cache)."""
+        gen, copy, gate, k_cache, v_cache = self.dist_parts_step(
+            mask, tok, pos_idx, k_cache, v_cache, cross_k, cross_v,
+            src_proj, self_mask)
         fused = jnp.concatenate(
             [gate[:, :, 0:1] * gen, gate[:, :, 1:2] * copy], axis=-1
         )

@@ -12,7 +12,7 @@
 cd "$(dirname "$0")/.." || exit 1
 LOG=tpu_watchdog.log
 echo "[roundup] start $(date -u +%FT%TZ)" >> "$LOG"
-for i in $(seq 1 200); do
+for i in $(seq 1 500); do
   if FIRA_BENCH_PROBE_TIMEOUT=60 timeout 70 python bench.py --probe >> "$LOG" 2>/dev/null; then
     echo "[roundup] tunnel up on probe $i $(date -u +%FT%TZ)" >> "$LOG"
     for job in scripts/tpu_diag4.py; do

@@ -267,6 +267,34 @@ def test_kv_cached_beam_matches_full_redecode(tiny_setup, tiny_model_state):
                                    rtol=2e-5, atol=1e-7)
 
 
+def test_factored_topk_beam_matches_fused(tiny_setup, tiny_model_state):
+    """cfg.beam_factored_topk selects from per-side top-ks (2K candidates
+    per beam) instead of the assembled 25,020-way fused tensor. The
+    selection is exact for the top-k values, so tokens and scores must
+    match the fused path — in both prob modes and both cache modes."""
+    import dataclasses
+
+    dataset = tiny_setup
+    model, state, _ = tiny_model_state
+    test_split = dataset.splits["test"]
+
+    for compat in (True, False):
+        for impl in (beam_search, beam_search_cached):
+            cfg = dataclasses.replace(dataset.cfg,
+                                      beam_compat_prob_space=compat)
+            cfg_f = dataclasses.replace(cfg, beam_factored_topk=True)
+            batch = make_batch(test_split,
+                               np.arange(min(4, len(test_split))), cfg)
+            tok_a, p_a = jax.jit(
+                lambda p, b: impl(model, p, b, cfg))(state.params, batch)
+            tok_b, p_b = jax.jit(
+                lambda p, b: impl(model, p, b, cfg_f))(state.params, batch)
+            np.testing.assert_array_equal(np.asarray(tok_a),
+                                          np.asarray(tok_b))
+            np.testing.assert_allclose(np.asarray(p_a), np.asarray(p_b),
+                                       rtol=2e-5, atol=1e-7)
+
+
 def test_prefetch_to_device_matches_direct_feed(tiny_setup, tiny_model_state):
     """The double-buffered input pipeline must be semantics-free: same
     batches in the same order, host-computed n_valid, and step losses
