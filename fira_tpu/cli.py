@@ -171,9 +171,11 @@ def _resolve_cfg(args):
     if args.typed_edges:
         overrides["typed_edges"] = True
     # --accum-steps conflicts with the production preset's fused device
-    # loop (mutually exclusive by config contract); an explicit accum
-    # request drops the preset's fused_steps unless the user also pinned it
-    if (overrides.get("accum_steps", 1) > 1 and cfg.fused_steps > 1
+    # loop (mutually exclusive by config contract); an accum request —
+    # whether from the CLI or baked into the named config/preset — drops
+    # fused_steps unless the user pinned it explicitly
+    effective_accum = overrides.get("accum_steps", cfg.accum_steps)
+    if (effective_accum > 1 and cfg.fused_steps > 1
             and "fused_steps" not in overrides):
         overrides["fused_steps"] = 1
     return cfg.replace(**overrides) if overrides else cfg

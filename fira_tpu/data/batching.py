@@ -69,7 +69,13 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
             f"range (max id {np.iinfo(np.int16).max}); widen the id dtype")
     for f in ("diff", "msg", "msg_tar", "sub_token"):
         batch[f] = batch[f].astype(np.int16)
-    batch["diff_mark"] = batch["diff_mark"].astype(np.int8)  # values 0..3
+    # mark vocabulary is 0..3 today; guard like the int16 fields so a future
+    # mark-vocabulary change fails loudly instead of wrapping on the wire
+    if batch["diff_mark"].size and batch["diff_mark"].max() > np.iinfo(np.int8).max:
+        raise ValueError(
+            f"diff_mark max {batch['diff_mark'].max()} exceeds int8 wire "
+            f"range (max {np.iinfo(np.int8).max}); widen the mark dtype")
+    batch["diff_mark"] = batch["diff_mark"].astype(np.int8)
     if cfg.ast_change_vocab_size - 1 > np.iinfo(np.int16).max:
         raise ValueError(
             f"ast_change_vocab_size={cfg.ast_change_vocab_size} exceeds "

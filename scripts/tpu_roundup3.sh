@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-5 harvest queue (VERDICT r4 items 1 and 4): probe until the tunnel
+# answers, then run, in priority order,
+#   1. tpu_ablate2.py   second-wave endpoints (split_buffer, stacked_split,
+#                       stacked_flat, stacked_split_flat) + base/stacked re-pin
+#   2. bench.py         driver-style artifact under the round-5 defaults
+#   3. tpu_decode_bench.py at batch 170 and 512 (kv_factored_topk included)
+#   4. production per-op profile (XSpace) under the winning knob set
+#   5. tpu_diag4.py     scatter variants
+# then exec tpu_watchdog2.sh, which resumes the FULLSCALE v2 campaign
+# (.watchdog_perf_done keeps it off the already-done round-4 harvest).
+#
+# Stand-down: touch .harvest_standdown to make the loop exit before the
+# driver's own end-of-round bench.py run (background clients must not
+# contend with it); the loop also respects STOP_AT (epoch seconds).
+# Usage: nohup bash scripts/tpu_roundup3.sh >/dev/null 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+LOG=tpu_watchdog.log
+STOP_AT=${STOP_AT:-$(( $(date +%s) + 37800 ))}   # default: now + 10.5 h
+echo "[roundup3] start $(date -u +%FT%TZ) stop_at=$(date -u -d @"$STOP_AT" +%FT%TZ)" >> "$LOG"
+for i in $(seq 1 600); do
+  [ -f .harvest_standdown ] && { echo "[roundup3] stand-down flag, exiting $(date -u +%FT%TZ)" >> "$LOG"; exit 0; }
+  [ "$(date +%s)" -ge "$STOP_AT" ] && { echo "[roundup3] stop_at reached, exiting $(date -u +%FT%TZ)" >> "$LOG"; exit 0; }
+  if FIRA_BENCH_PROBE_TIMEOUT=60 timeout 70 python bench.py --probe >> "$LOG" 2>/dev/null; then
+    echo "[roundup3] tunnel up on probe $i $(date -u +%FT%TZ)" >> "$LOG"
+    echo "[roundup3] ablate2 second wave $(date -u +%FT%TZ)" >> "$LOG"
+    FIRA_ABLATE2_ONLY=base,stacked,split_buffer,stacked_split,stacked_flat,stacked_split_flat timeout 2200 python scripts/tpu_ablate2.py >> "$LOG" 2>&1
+    echo "[roundup3] ablate2 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    echo "[roundup3] driver-style bench $(date -u +%FT%TZ)" >> "$LOG"
+    FIRA_BENCH_PROBE_BUDGET=120 timeout 1200 python bench.py >> "$LOG" 2>&1
+    echo "[roundup3] bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    for B in 170 512; do
+      echo "[roundup3] decode bench batch=$B $(date -u +%FT%TZ)" >> "$LOG"
+      DECODE_BATCH=$B timeout 1500 python scripts/tpu_decode_bench.py >> "$LOG" 2>&1
+      echo "[roundup3] decode$B rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    done
+    echo "[roundup3] production per-op profile $(date -u +%FT%TZ)" >> "$LOG"
+    PROFILE_DIR=/tmp/fira_tpu_trace_prod PROFILE_OVERRIDES='{"rng_impl":"rbg","sort_edges":true,"stable_residual":false,"copy_head_remat":false,"encoder_buffer":"split","flat_scatter":true}' timeout 1400 python scripts/tpu_profile.py >> "$LOG" 2>&1
+    echo "[roundup3] profile rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    echo "[roundup3] diag4 $(date -u +%FT%TZ)" >> "$LOG"
+    timeout 1400 python scripts/tpu_diag4.py >> "$LOG" 2>&1
+    echo "[roundup3] diag4 rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    echo "[roundup3] handing back to watchdog2 (fullscale_v2 campaign) $(date -u +%FT%TZ)" >> "$LOG"
+    exec bash scripts/tpu_watchdog2.sh
+  fi
+  sleep 120
+done
+echo "[roundup3] gave up $(date -u +%FT%TZ)" >> "$LOG"

@@ -1,0 +1,111 @@
+"""The driver contract bench.py must honor (VERDICT r4 items 2 and 7b): the
+driver wrapping `python bench.py` parses the LAST JSON line of stdout and may
+SIGKILL the process at ANY time (rounds 1-4's BENCH_r*.json artifacts were
+null exactly when a kill landed before the single final print). These tests
+run the real orchestrator against an always-hanging probe (the
+FIRA_BENCH_TEST_HANG_S hook — no backend is touched), SIGKILL it at varied
+times, and assert the stdout tail is always a parseable structured record."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _kill_after(delay_s: float, env_extra: dict) -> str:
+    """Launch the orchestrator, SIGKILL it after delay_s, return stdout."""
+    env = dict(os.environ)
+    env.update({
+        # every probe hangs (simulated tunnel outage), killed at the 1-s
+        # probe timeout, retried until the 30-s budget — the orchestrator
+        # is mid-probe-loop whenever the kill lands
+        "FIRA_BENCH_TEST_HANG_S": "999",
+        "FIRA_BENCH_PROBE_TIMEOUT": "1",
+        "FIRA_BENCH_PROBE_BUDGET": "30",
+        "FIRA_BENCH_RETRY_SLEEP": "0",
+        "FIRA_BENCH_PROBE_RETRY_SLEEP": "0",
+    })
+    env.update(env_extra)
+    with tempfile.TemporaryFile(mode="w+") as out:
+        p = subprocess.Popen([sys.executable, BENCH], stdout=out,
+                             stderr=subprocess.DEVNULL, env=env, cwd=REPO)
+        try:
+            p.wait(timeout=delay_s)
+        except subprocess.TimeoutExpired:
+            p.send_signal(signal.SIGKILL)
+            p.wait()
+        out.seek(0)
+        return out.read()
+
+
+def _last_json_line(out: str) -> dict:
+    lines = [ln for ln in out.strip().splitlines()
+             if ln.strip().startswith("{")]
+    assert lines, f"no JSON line in stdout:\n{out!r}"
+    return json.loads(lines[-1])
+
+
+def test_sigkill_at_random_times_leaves_parseable_tail():
+    # Kill points chosen to land (a) right after startup, before the first
+    # probe resolves, (b) mid probe-retry loop, (c) deeper into the loop.
+    # (Interpreter boot on this image is ~1.5-2 s — the sandbox's
+    # sitecustomize — so the earliest meaningful kill is just after that;
+    # the driver's real kill window is minutes, not milliseconds.)
+    # The contract: whatever the timing, the last stdout line parses as the
+    # structured record with the metric name and a null value.
+    for delay in (2.5, 4.0, 6.5):
+        out = _kill_after(delay, {})
+        rec = _last_json_line(out)
+        assert rec["metric"] == "train_commits_per_sec_per_chip", rec
+        assert rec["value"] is None
+        assert rec["unit"] == "commits/sec/chip"
+        assert rec["vs_baseline"] is None
+        assert "error" in rec and rec["error"], rec
+        assert isinstance(rec.get("attempts"), list)
+
+
+def test_budget_exhaustion_emits_final_record():
+    # No kill: the orchestrator exhausts a tiny probe budget on hung probes
+    # and must exit nonzero with a FINAL (not in_progress) record whose
+    # attempts list the probe failures.
+    env = dict(os.environ)
+    env.update({
+        "FIRA_BENCH_TEST_HANG_S": "999",
+        "FIRA_BENCH_PROBE_TIMEOUT": "1",
+        "FIRA_BENCH_PROBE_BUDGET": "3",
+        "FIRA_BENCH_RETRY_SLEEP": "0",
+        "FIRA_BENCH_PROBE_RETRY_SLEEP": "0",
+    })
+    p = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, timeout=60, env=env, cwd=REPO)
+    rec = _last_json_line(p.stdout)
+    assert p.returncode != 0
+    assert rec["value"] is None
+    assert not rec.get("in_progress"), rec
+    assert any(a.get("phase") == "probe" for a in rec["attempts"]
+               if isinstance(a, dict))
+
+
+def test_status_records_updated_every_probe():
+    # Run long enough for several probe attempts; every attempt must have
+    # appended a fresh flushed status line (not just the startup one), so a
+    # kill between attempts always sees the newest state.
+    t0 = time.time()
+    out = _kill_after(6.0, {})
+    assert time.time() - t0 < 30
+    lines = [ln for ln in out.strip().splitlines()
+             if ln.strip().startswith("{")]
+    # startup record + >=2 probe-failure status records in ~4s of 1-s
+    # probe timeouts after the ~2s interpreter boot
+    assert len(lines) >= 3, out
+    in_progress = [json.loads(ln) for ln in lines]
+    assert all(r.get("in_progress") for r in in_progress), lines[-1]
+    # later records carry the probe attempts
+    assert any("probe attempt" in (r.get("error") or "")
+               for r in in_progress), lines
