@@ -71,6 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(generation vocab + copy positions, gate-scaled) "
                         "instead of the assembled 25,020-way fused tensor "
                         "— token-exact (pinned by tests)")
+    p.add_argument("--beam-early-exit", action="store_true",
+                   help="test: stop the decode loop once every beam has "
+                        "emitted EOS (+1 settling step) — bit-exact vs the "
+                        "full tar_len scan, wall clock scales with the "
+                        "batch's longest message")
     p.add_argument("--beam-log-space", action="store_true",
                    help="log-space beam accumulation instead of the "
                         "reference-compat probability space")
@@ -152,6 +157,8 @@ def _resolve_cfg(args):
         overrides["beam_compat_prob_space"] = False
     if args.beam_factored_topk:
         overrides["beam_factored_topk"] = True
+    if args.beam_early_exit:
+        overrides["beam_early_exit"] = True
     if args.adjacency:
         overrides["adjacency_impl"] = args.adjacency
     if args.encoder_buffer:

@@ -125,6 +125,20 @@ class FiraConfig:
     # the fused scan order, which is why this is a knob and the
     # token-equality pins ride the test fixtures.
     beam_factored_topk: bool = False
+    # Stop the decode loop once every beam of every batch item has emitted
+    # EOS (plus ONE settling step), instead of always scanning tar_len-1
+    # positions. Bit-exact vs the full scan: finished beams are masked to
+    # the sentinel construction, whose only effect past saturation is a
+    # single prob-descending re-sort of the beams — the settling step runs
+    # it, after which the state is an element-wise fixed point (top_k is
+    # stable on the already-sorted sentinel vector). The reference's own
+    # Python loop early-exits the same way (run_model.py:276-279). Wall
+    # clock scales with the batch's LONGEST message instead of tar_len —
+    # the win on real corpora (mean message ~8-10 of 30 positions) is
+    # bounded by the per-batch max length, so smaller test batches win
+    # more. Parity default off; pinned equivalent in all four
+    # kv-cache x factored-topk modes by tests/test_beam_early_exit.py.
+    beam_early_exit: bool = False
 
     # --- typed edges (beyond-parity extension) ---
     # The reference computes six edge families then flattens them into one

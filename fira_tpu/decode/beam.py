@@ -24,8 +24,11 @@ finished for EVERY batch item (cal_beam, :229-247) and compacts the sentinel
 list (:286-296); per item that yields exactly the candidate set built here
 (active beams: dist x prob; finished beams: -1-mask + sentinel), so the
 fixed-shape formulation selects the same beams without data-dependent
-control flow. Early loop exit (:276-279) is replaced by running all steps —
-finished beams are fixed points of the update.
+control flow. The reference's early loop exit (:276-279) defaults to
+running all steps here — finished beams are fixed points of the update —
+and comes back as cfg.beam_early_exit: a `lax.while_loop` that stops one
+settling step after every beam finishes, bit-exact vs the full scan (see
+:func:`_run_steps`).
 """
 
 from __future__ import annotations
@@ -159,10 +162,45 @@ def _select(dist, tokens, probs, finished, s, batch, cfg: FiraConfig, neg):
                            batch, cfg, neg)
 
 
+def _run_steps(step, carry0, T: int, early_exit: bool):
+    """Drive the per-position beam step over positions 0..T-2.
+
+    early_exit=False: plain `lax.scan` (always T-1 steps — the parity
+    default). early_exit=True: `lax.while_loop` that stops once every beam
+    of every item is finished AND one settling step has run after
+    saturation. The settling step matters for bit-exactness: the first
+    all-finished step re-sorts beams prob-descending via the sentinel
+    top-k; after it the state is an element-wise fixed point (stable top_k
+    on a sorted vector), so skipping the remaining steps changes nothing.
+    `finished` is carry[2] in both beam variants.
+
+    Returns (final_carry, steps_run) — steps_run is a traced scalar under
+    early exit (T-1 exactly otherwise)."""
+    if not early_exit:
+        carry, _ = jax.lax.scan(step, carry0, jnp.arange(T - 1))
+        return carry, jnp.int32(T - 1)
+
+    def cond(state):
+        s, settled, carry = state
+        return (s < T - 1) & ~(settled & jnp.all(carry[2]))
+
+    def body(state):
+        s, settled, carry = state
+        new_carry, _ = step(carry, s)
+        return s + 1, jnp.all(carry[2]), new_carry
+
+    s, _, carry = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.asarray(False), carry0))
+    return carry, s
+
+
 def beam_search(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
-                cfg: FiraConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                cfg: FiraConfig, with_steps: bool = False,
+                ) -> Tuple[jnp.ndarray, ...]:
     """Returns (tokens (B, beam, tar_len) with copy ids already resolved,
     scores (B, beam)). The best beam is argmax(scores) (run_model.py:351).
+    with_steps=True appends the number of decode positions actually run
+    (a scalar; < tar_len-1 only under cfg.beam_early_exit).
 
     Jit this via `make_beam_step` below or wrap in jax.jit at the call site;
     everything inside is fixed-shape.
@@ -206,14 +244,14 @@ def beam_search(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
             dist, tokens, probs, finished, s, batch, cfg, neg)
         return (new_tokens, new_probs, new_finished), None
 
-    (tokens, probs, _), _ = jax.lax.scan(
-        step, (tokens0, probs0, finished0), jnp.arange(T - 1)
-    )
-    return tokens, probs
+    (tokens, probs, _), steps = _run_steps(
+        step, (tokens0, probs0, finished0), T, cfg.beam_early_exit)
+    return (tokens, probs, steps) if with_steps else (tokens, probs)
 
 
 def beam_search_cached(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
-                       cfg: FiraConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                       cfg: FiraConfig, with_steps: bool = False,
+                       ) -> Tuple[jnp.ndarray, ...]:
     """KV-cached beam search: identical selection semantics to
     :func:`beam_search` (the equivalence is pinned by
     tests/test_train_decode.py), but each scan step decodes ONE position via
@@ -285,14 +323,32 @@ def beam_search_cached(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
         return (new_tokens, new_probs, new_finished,
                 gather_cache(k_cache), gather_cache(v_cache)), None
 
-    (tokens, probs, *_), _ = jax.lax.scan(
-        step, (tokens0, probs0, finished0, cache0, cache0), jnp.arange(T - 1)
-    )
-    return tokens, probs
+    (tokens, probs, *_), steps = _run_steps(
+        step, (tokens0, probs0, finished0, cache0, cache0), T,
+        cfg.beam_early_exit)
+    return (tokens, probs, steps) if with_steps else (tokens, probs)
 
 
-def make_beam_search(model: FiraModel, cfg: FiraConfig):
+def make_beam_search(model: FiraModel, cfg: FiraConfig,
+                     with_steps: bool = False):
     """jit-compiled beam search closure over (params, batch); KV-cached by
-    default (cfg.beam_kv_cache), full-prefix re-decode otherwise."""
+    default (cfg.beam_kv_cache), full-prefix re-decode otherwise.
+    with_steps=True makes the closure return (tokens, probs, steps_run)."""
     impl = beam_search_cached if cfg.beam_kv_cache else beam_search
-    return jax.jit(lambda params, batch: impl(model, params, batch, cfg))
+    return jax.jit(lambda params, batch: impl(model, params, batch, cfg,
+                                              with_steps=with_steps))
+
+
+def eos_biased_params(params, delta: float = 8.0):
+    """A paramset whose generation head is biased hard toward EOS, so every
+    beam finishes within a few positions. Test/bench utility: saturates the
+    beam_early_exit path deterministically (tests/test_beam_early_exit.py
+    pins exactness with it; tpu_decode_bench.py uses it for the best-case
+    `_saturated` rows). Shared here so the out_fc param path and the bias
+    magnitude cannot drift between the two."""
+    from fira_tpu.data.vocab import EOS_ID
+
+    bias = np.asarray(params["out_fc"]["bias"]).copy()
+    bias[EOS_ID] += delta
+    return {**params,
+            "out_fc": {**params["out_fc"], "bias": jnp.asarray(bias)}}

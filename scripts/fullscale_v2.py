@@ -202,9 +202,17 @@ def main() -> None:
             else:
                 raise RuntimeError(
                     f"{variant}: no checkpoint to decode from — train first")
+            # decode with the bit-exact early exit (tests/
+            # test_beam_early_exit.py) unless opted out: the planted-corpus
+            # messages are 2-7 tokens of tar_len 30, and decode is the
+            # campaign's wall-clock bottleneck. Not part of
+            # config_overrides — it cannot change any score.
+            early = os.environ.get("FS2_DECODE_EARLY", "1") == "1"
+            cfg_dec = cfg.replace(beam_early_exit=True) if early else cfg
+            vrep["decode_early_exit"] = early
             t0 = time.time()
-            metrics = run_test(model, params, dataset, out_dir=out_dir,
-                               var_maps=var_maps)
+            metrics = run_test(model, params, dataset, cfg_dec,
+                               out_dir=out_dir, var_maps=var_maps)
             vrep["decode_secs"] = round(time.time() - t0, 1)
             vrep["sentence_bleu"] = round(metrics["sentence_bleu"], 4)
             assert os.path.exists(out_path), metrics

@@ -1,0 +1,99 @@
+"""cfg.beam_early_exit must be BIT-EXACT vs the full tar_len-1 scan in every
+decode mode (kv-cache x factored-topk x prob/log space), and must actually
+stop early when all beams finish.
+
+Exactness argument being pinned (decode/beam.py::_run_steps): once every
+beam of every item is finished, one more step re-sorts beams by sentinel
+probability; after that settling step the carry is an element-wise fixed
+point, so the while_loop's exit point produces the same (tokens, probs) as
+running all steps. The EOS-biased fixture forces saturation within a few
+positions so the early path is genuinely exercised, not vacuously equal.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from fira_tpu.config import fira_tiny
+from fira_tpu.data.batching import make_batch
+from fira_tpu.data.dataset import FiraDataset
+from fira_tpu.data.synthetic import write_corpus_dir
+from fira_tpu.data.vocab import EOS_ID
+from fira_tpu.decode.beam import eos_biased_params, make_beam_search
+from fira_tpu.model.model import FiraModel
+from fira_tpu.train.state import init_state
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    data_dir = str(tmp_path_factory.mktemp("corpus"))
+    write_corpus_dir(data_dir, n_commits=32, seed=11)
+    cfg = fira_tiny(batch_size=8, test_batch_size=6)
+    dataset = FiraDataset(data_dir, cfg)
+    cfg = dataset.cfg
+    model = FiraModel(cfg)
+    batch = make_batch(dataset.splits["train"], np.arange(6), cfg)
+    params = init_state(model, cfg, batch).params
+    # EOS-biased head so every beam finishes within a few positions —
+    # random-init params rarely emit EOS at all, which would make the
+    # early-exit path vacuous (loop runs to tar_len-1 anyway). Shared with
+    # the decode bench's `_saturated` rows.
+    return cfg, model, batch, params, eos_biased_params(params)
+
+
+MODES = [
+    # (kv_cache, factored_topk, compat_prob_space)
+    (False, False, True),
+    (False, True, True),
+    (True, False, True),
+    (True, True, True),
+    (True, False, False),   # log-space: -inf sentinel arithmetic
+    (False, False, False),
+]
+
+
+@pytest.mark.parametrize("kv,fac,compat", MODES)
+def test_early_exit_bit_exact_and_early(setup, kv, fac, compat):
+    cfg0, model0, batch, _params, eos_params = setup
+    cfg = dataclasses.replace(cfg0, beam_kv_cache=kv, beam_factored_topk=fac,
+                              beam_compat_prob_space=compat)
+
+    def run(early):
+        c = dataclasses.replace(cfg, beam_early_exit=early)
+        fn = make_beam_search(FiraModel(c), c, with_steps=True)
+        toks, probs, steps = fn(eos_params, batch)
+        return np.asarray(toks), np.asarray(probs), int(steps)
+
+    toks_full, probs_full, steps_full = run(early=False)
+    toks_ee, probs_ee, steps_ee = run(early=True)
+
+    assert steps_full == cfg.tar_len - 1
+    # EOS-biased params saturate every beam within a few positions; the
+    # early loop must stop well short of the full scan
+    assert steps_ee < steps_full, (steps_ee, steps_full)
+    np.testing.assert_array_equal(toks_ee, toks_full)
+    np.testing.assert_array_equal(probs_ee, probs_full)
+
+
+def test_early_exit_no_eos_runs_full_length(setup):
+    # Random-init params essentially never emit EOS: the early-exit loop
+    # must degrade to exactly the full scan (same steps, same outputs).
+    cfg0, model0, batch, params, _eos = setup
+    cfg = dataclasses.replace(cfg0, beam_early_exit=True, beam_kv_cache=True)
+    fn = make_beam_search(FiraModel(cfg), cfg, with_steps=True)
+    toks_ee, probs_ee, steps = fn(params, batch)
+
+    cfg_f = dataclasses.replace(cfg0, beam_early_exit=False,
+                                beam_kv_cache=True)
+    fn_f = make_beam_search(FiraModel(cfg_f), cfg_f, with_steps=True)
+    toks_full, probs_full, steps_full = fn_f(params, batch)
+
+    np.testing.assert_array_equal(np.asarray(toks_ee), np.asarray(toks_full))
+    np.testing.assert_array_equal(np.asarray(probs_ee),
+                                  np.asarray(probs_full))
+    # if no beam ever finishes, both run all tar_len-1 positions
+    finished_any = bool((np.asarray(toks_full)[:, :, 1:] == EOS_ID).any())
+    if not finished_any:
+        assert int(steps) == int(steps_full) == cfg.tar_len - 1
