@@ -1,0 +1,26 @@
+"""firacheck — JAX-hazard static analyzer + runtime sanitizer.
+
+The repo's throughput wins rest on invariants that used to live only in
+prose (README "Design notes", docs/PERF.md): the driver never syncs with
+the device except at logging/dev boundaries, train-step buffers are
+donated, every program compiles exactly once over fixed geometry, and PRNG
+keys are never reused. firacheck turns those into machine-checked
+contracts:
+
+- static: ``python -m fira_tpu.analysis.cli check fira_tpu tests scripts``
+  walks the AST of every file and emits ``file:line [RULE-ID] severity:
+  message`` findings (nonzero exit on errors). Rules: HOST-SYNC, RETRACE,
+  DONATION, PRNG-REUSE, DISCARDED-AT, GEOMETRY-DRIFT — see
+  docs/ANALYSIS.md for each rule's rationale and examples.
+- runtime: ``--sanitize`` on the train/test CLIs arms
+  ``analysis.sanitizer`` — jax_debug_nans/jax_debug_infs plus a
+  jax_log_compiles capture whose per-program compile-count guard raises if
+  any step after a program's first dispatch triggers a new compilation.
+
+Deliberate boundary syncs are waived in place with
+``# firacheck: allow[RULE-ID] <reason naming the invariant>``; a reason is
+mandatory (a bare allow is itself a finding).
+"""
+
+from fira_tpu.analysis.findings import Finding, Severity  # noqa: F401
+from fira_tpu.analysis.engine import check_paths, check_source  # noqa: F401

@@ -1,0 +1,212 @@
+"""Shared AST plumbing: dotted-name resolution, parent/ancestor walks, and
+hot-loop-region designation.
+
+Hot regions are where a host sync is a throughput bug rather than a
+boundary. Designation (the README "Design notes" invariant, mechanized):
+
+1. anywhere: bodies of functions handed to ``jax.lax.scan`` /
+   ``while_loop`` / ``fori_loop`` / ``cond`` (traced — a sync there is a
+   trace-time error or a silent per-step host round-trip);
+2. anywhere: bodies of jit/pmap-wrapped or -decorated functions;
+3. designated driver files (train/loop.py, train/step.py,
+   decode/runner.py, decode/beam.py): every ``for``/``while`` loop body
+   (the step-dispatch loops whose cadence IS the throughput story) and
+   every function nested inside a function (the step closures those
+   drivers build);
+4. closure: a same-module function called by name from a hot region is hot
+   too (catches helpers like train/loop.py ``_materialize`` that
+   encapsulate the sync).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+_SCAN_CALLS = {
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.cond", "lax.cond",
+}
+_JIT_CALLS = {"jax.jit", "jit", "jax.pmap", "pmap", "pjit", "jax.pjit"}
+
+# The designated dispatch drivers whose for/while bodies are hot: the
+# train loop, the train-step factories, and the decode drivers. NOT every
+# train/decode module — e.g. decode/text.py is host-only text cooking and
+# train/state.py is checkpoint I/O (already a boundary by definition).
+_DRIVER_FILES = (
+    "fira_tpu/train/loop.py", "fira_tpu/train/step.py",
+    "fira_tpu/decode/runner.py", "fira_tpu/decode/beam.py",
+)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def last_segment(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def ancestors(node: ast.AST, parents: Dict[ast.AST, ast.AST]
+              ) -> Iterator[ast.AST]:
+    while node in parents:
+        node = parents[node]
+        yield node
+
+
+def enclosing_function(node: ast.AST, parents: Dict[ast.AST, ast.AST]
+                       ) -> Optional[ast.AST]:
+    for a in ancestors(node, parents):
+        if isinstance(a, FunctionNode):
+            return a
+    return None
+
+
+def is_jit_call(call: ast.Call) -> bool:
+    name = call_name(call)
+    if name in _JIT_CALLS:
+        return True
+    # functools.partial(jax.jit, ...) used as a decorator/factory
+    if last_segment(name) == "partial" and call.args:
+        return dotted(call.args[0]) in _JIT_CALLS
+    return False
+
+
+def normalize_path(path: str) -> str:
+    """Absolute, forward-slash form for rule SCOPING (display paths stay
+    as given). Without this, a checkout-relative invocation from inside
+    the package ('check train/loop.py' with cwd fira_tpu/) would silently
+    disarm the path-scoped rules and report a clean scan."""
+    return os.path.abspath(path).replace("\\", "/")
+
+
+def is_driver_module(path: str) -> bool:
+    norm = normalize_path(path)
+    return any(norm.endswith(f) for f in _DRIVER_FILES)
+
+
+@dataclasses.dataclass(frozen=True)
+class HotSpan:
+    start: int
+    end: int
+    desc: str
+
+    def covers(self, line: int) -> bool:
+        return self.start <= line <= self.end
+
+
+def _body_span(node: ast.AST, desc: str) -> Optional[HotSpan]:
+    end = getattr(node, "end_lineno", None)
+    if end is None:
+        return None
+    return HotSpan(node.lineno, end, desc)
+
+
+def _function_name(node: ast.AST) -> str:
+    return getattr(node, "name", "<lambda>")
+
+
+def hot_spans(tree: ast.AST, path: str,
+              parents: Dict[ast.AST, ast.AST]) -> List[HotSpan]:
+    spans: List[HotSpan] = []
+    func_defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # last definition wins; good enough for flat modules
+            func_defs[node.name] = node
+
+    def add_function(node: ast.AST, desc: str) -> None:
+        span = _body_span(node, desc)
+        if span:
+            spans.append(span)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in _SCAN_CALLS:
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        add_function(arg, f"{name} body")
+                    elif isinstance(arg, ast.Name) and arg.id in func_defs:
+                        add_function(func_defs[arg.id],
+                                     f"{name} body `{arg.id}`")
+            elif is_jit_call(node):
+                for arg in node.args:
+                    if isinstance(arg, ast.Lambda):
+                        add_function(arg, "jitted lambda")
+                    elif isinstance(arg, ast.Name) and arg.id in func_defs:
+                        add_function(func_defs[arg.id],
+                                     f"jitted function `{arg.id}`")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if ((isinstance(dec, ast.Call) and is_jit_call(dec))
+                        or dotted(dec) in _JIT_CALLS):
+                    add_function(node, f"jit-decorated `{node.name}`")
+
+    if is_driver_module(path):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.While)):
+                span = _body_span(node, f"driver loop (line {node.lineno})")
+                if span:
+                    spans.append(span)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if isinstance(enclosing_function(node, parents), FunctionNode):
+                    add_function(node, f"driver step closure `{node.name}`")
+
+    # Closure: same-module functions called from hot regions become hot.
+    def covered(line: int) -> Optional[HotSpan]:
+        for s in spans:
+            if s.covers(line):
+                return s
+        return None
+
+    changed = True
+    hot_names: Set[str] = set()
+    while changed:
+        changed = False
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = call_name(node)
+            if fname in func_defs and fname not in hot_names \
+                    and covered(node.lineno):
+                hot_names.add(fname)
+                add_function(func_defs[fname],
+                             f"`{fname}` (called from hot region, line "
+                             f"{node.lineno})")
+                changed = True
+    return spans
+
+
+def hot_region_at(spans: List[HotSpan], line: int) -> Optional[HotSpan]:
+    best: Optional[HotSpan] = None
+    for s in spans:
+        if s.covers(line) and (best is None or s.start >= best.start):
+            best = s  # innermost (latest-starting) region names the message
+    return best

@@ -1,0 +1,75 @@
+"""firacheck CLI.
+
+Usage:
+    python -m fira_tpu.analysis.cli check fira_tpu tests scripts
+    python -m fira_tpu.analysis.cli check --no-suppress fira_tpu
+    python -m fira_tpu.analysis.cli list-rules
+
+``check`` prints one ``file:line [RULE-ID] severity: message`` per finding
+and exits 1 if any ERROR survives the suppression baseline (warnings never
+gate). ``--no-suppress`` shows the raw pre-waiver findings — the view a
+reviewer uses to audit the committed baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from fira_tpu.analysis import engine
+from fira_tpu.analysis.findings import RULES, Severity
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="python -m fira_tpu.analysis.cli",
+                                description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+    chk = sub.add_parser("check", help="analyze paths; exit 1 on errors")
+    chk.add_argument("paths", nargs="+",
+                     help="files or directories to analyze")
+    chk.add_argument("--no-suppress", action="store_true",
+                     help="show raw pre-waiver findings (audit view for "
+                          "the committed baseline). The exit status then "
+                          "reflects the RAW findings too, so a cleanly "
+                          "baselined repo may still exit 1 here")
+    chk.add_argument("--quiet", action="store_true",
+                     help="suppress the summary line")
+    sub.add_parser("list-rules", help="print the rule registry")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "list-rules":
+        for rule, doc in sorted(RULES.items()):
+            print(f"{rule}: {doc}")
+        return 0
+
+    # resolve the file list once; check_paths' own iter_py_files pass over
+    # already-resolved .py paths is a cheap isfile sweep, not a re-walk.
+    # An argument resolving to NO files gates: a mistyped or renamed path
+    # must not turn into a silently-green scan over nothing
+    files = []
+    empty = []
+    for p in args.paths:
+        got = engine.iter_py_files([p])
+        (files.extend(got) if got else empty.append(p))
+    if empty:
+        print(f"firacheck: no Python files under {', '.join(empty)} — "
+              f"refusing to report a clean scan over nothing",
+              file=sys.stderr)
+        return 1
+    findings = engine.check_paths(files, suppress=not args.no_suppress)
+    for f in findings:
+        print(f.render())
+    n_err = sum(1 for f in findings if f.severity is Severity.ERROR)
+    n_warn = len(findings) - n_err
+    if not args.quiet:
+        print(f"firacheck: {n_err} error(s), {n_warn} warning(s) over "
+              f"{len(files)} file(s)", file=sys.stderr)
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,65 @@
+"""Finding/severity types and the one true output format.
+
+Every rule reports through :class:`Finding`; the CLI renders
+``file:line [RULE-ID] severity: message`` so editors, grep-based
+baselines, and the golden fixture test all parse one shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class Severity(enum.Enum):
+    ERROR = "error"      # breaks a performance/correctness invariant
+    WARNING = "warning"  # suspicious; heuristic or advisory
+
+    def __str__(self) -> str:
+        return self.value
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str        # as given to the checker (kept relative for stable output)
+    line: int        # 1-based
+    rule: str        # e.g. "HOST-SYNC"
+    severity: Severity
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line} [{self.rule}] "
+                f"{self.severity}: {self.message}")
+
+
+# Rule registry: id -> one-line contract (docs/ANALYSIS.md holds the long
+# form). Kept here so `cli.py list-rules`, the engine's suppression
+# validation, and the docs can't drift apart on the id set.
+RULES = {
+    "HOST-SYNC": (
+        "host/device sync primitive (.item()/float()/int()/bool()/"
+        "np.asarray/jax.device_get/block_until_ready) inside a hot-loop "
+        "region"),
+    "RETRACE": (
+        "jax.jit constructed inside a loop body, unhashable static "
+        "arguments, or a jitted closure baking captured arrays into the "
+        "trace"),
+    "DONATION": (
+        "a buffer passed at a donate_argnums position is read again after "
+        "the donating call"),
+    "PRNG-REUSE": (
+        "the same PRNG key fed to two jax.random consumers without an "
+        "intervening split/fold_in"),
+    "DISCARDED-AT": (
+        "x.at[...].set/add(...) result discarded — a silent no-op under "
+        "JAX's functional updates"),
+    "GEOMETRY-DRIFT": (
+        "a literal shape constant shadows the named geometry in config.py "
+        "(210/30/25/280/160/650 must be referenced, not re-typed)"),
+    "BAD-SUPPRESS": (
+        "malformed or reason-less firacheck suppression comment (every "
+        "waiver must name the invariant it waives)"),
+    "PARSE-ERROR": (
+        "file could not be read or parsed, so NONE of its invariants were "
+        "checked — a gating error, not a skip"),
+}

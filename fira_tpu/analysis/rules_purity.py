@@ -1,0 +1,196 @@
+"""PRNG-REUSE, DISCARDED-AT, GEOMETRY-DRIFT: functional-purity contracts.
+
+PRNG-REUSE — JAX keys are use-once: feeding the same key object to two
+``jax.random.*`` consumers silently correlates the draws (the dropout
+masks of two layers become identical, a bug no test of either layer alone
+catches). Intra-function dataflow: two consumer uses of one key name with
+no intervening rebind (``split``/``fold_in``/key-data plumbing don't count
+as consumers).
+
+DISCARDED-AT — ``x.at[i].set(v)`` returns a NEW array; as a bare
+expression statement it is a silent no-op (the torch-habits bug: in-place
+``tensor[i] = v`` thinking).
+
+GEOMETRY-DRIFT — the fixed geometry (210/30/25/280/160/650, config.py) is
+the one-compile contract's unit of account. A re-typed literal in package
+code silently diverges when a config scales; the named field must be
+referenced. Scoped to ``fira_tpu/`` (minus config.py, where the numbers
+are DEFINED, and this analysis package).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from fira_tpu.analysis import astutil
+from fira_tpu.analysis.findings import Finding, Severity
+
+_NONCONSUMING = {
+    "split", "fold_in", "PRNGKey", "key", "key_data", "wrap_key_data",
+    "clone", "key_impl", "default_prng_impl",
+}
+_RANDOM_PREFIXES = ("jax.random.", "jrandom.")  # NOT bare "random.":
+# stdlib random.shuffle etc. would false-positive; this repo always
+# qualifies jax.random fully.
+
+_GEOMETRY = {
+    210: "sou_len", 30: "tar_len", 25: "att_len", 280: "ast_change_len",
+    160: "sub_token_len", 650: "graph_len",
+}
+_AT_METHODS = {"set", "add", "multiply", "mul", "divide", "div", "power",
+               "min", "max", "apply", "get"}
+
+
+def _random_consumer(call: ast.Call) -> bool:
+    name = astutil.call_name(call)
+    if not name:
+        return False
+    for prefix in _RANDOM_PREFIXES:
+        if name.startswith(prefix):
+            fn = name[len(prefix):]
+            return "." not in fn and fn not in _NONCONSUMING
+    return False
+
+
+def _function_scopes(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def check_prng(path: str, tree: ast.AST, source: str, parents, spans,
+               ) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in _function_scopes(tree):
+        # events in source order: ('store', name) rebinds; ('use', name)
+        # consumes. Nested defs get their own scope pass, so skip their
+        # bodies here (a closure's key discipline is its own affair).
+        events: List[Tuple[int, str, str, ast.AST]] = []
+        nested = {id(sub) for stmt in fn.body for sub in ast.walk(stmt)
+                  if isinstance(sub, astutil.FunctionNode) and sub is not fn}
+
+        def in_nested(node: ast.AST, owner_ids=nested) -> bool:
+            for a in astutil.ancestors(node, parents):
+                if id(a) in owner_ids:
+                    return True
+                if a is fn:
+                    return False
+            return False
+
+        for stmt in fn.body:
+            for node in ast.walk(stmt):
+                if in_nested(node):
+                    continue
+                if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                             ast.Store):
+                    events.append((node.lineno, "store", node.id, node))
+                elif isinstance(node, ast.Call) and _random_consumer(node):
+                    key = node.args[0] if node.args else None
+                    if isinstance(key, ast.Name):
+                        events.append((node.lineno, "use", key.id, node))
+        def branch_arms(node: ast.AST):
+            """(id(if_node), arm) chain — two uses that sit in DIFFERENT
+            arms of a shared if/else are mutually exclusive, not reuse."""
+            arms = {}
+            child = node
+            for a in astutil.ancestors(node, parents):
+                if isinstance(a, ast.If):
+                    arm = "orelse" if child in a.orelse else "body"
+                    arms[id(a)] = arm
+                if a is fn:
+                    break
+                child = a
+            return arms
+
+        def exclusive(n1: ast.AST, n2: ast.AST) -> bool:
+            a1, a2 = branch_arms(n1), branch_arms(n2)
+            return any(a2.get(k, v) != v for k, v in a1.items())
+
+        events.sort(key=lambda e: e[0])
+        live_use: Dict[str, Tuple[int, ast.AST]] = {}
+        for lineno, kind, name, node in events:
+            if kind == "store":
+                live_use.pop(name, None)
+            elif name in live_use and not exclusive(live_use[name][1], node):
+                findings.append(Finding(
+                    path, lineno, "PRNG-REUSE", Severity.ERROR,
+                    f"key `{name}` already consumed by a jax.random call "
+                    f"at line {live_use[name][0]} and reused here without "
+                    f"split/fold_in: the two draws are perfectly "
+                    f"correlated"))
+            else:
+                live_use[name] = (lineno, node)
+    return findings
+
+
+def check_discarded_at(path: str, tree: ast.AST, source: str, parents,
+                       spans) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Expr) and isinstance(node.value,
+                                                          ast.Call)):
+            continue
+        call = node.value
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _AT_METHODS):
+            continue
+        # receiver chain must contain an `.at[...]` subscript
+        recv = call.func.value
+        has_at = False
+        probe = recv
+        while True:
+            if isinstance(probe, ast.Subscript):
+                if (isinstance(probe.value, ast.Attribute)
+                        and probe.value.attr == "at"):
+                    has_at = True
+                    break
+                probe = probe.value
+            elif isinstance(probe, (ast.Attribute, ast.Call)):
+                probe = (probe.value if isinstance(probe, ast.Attribute)
+                         else probe.func)
+            else:
+                break
+        if has_at:
+            findings.append(Finding(
+                path, node.lineno, "DISCARDED-AT", Severity.ERROR,
+                f"result of .at[...].{call.func.attr}(...) is discarded — "
+                f"JAX functional updates return a new array; assign it or "
+                f"delete the statement"))
+    return findings
+
+
+# sub-packages whose code must reference the named geometry; NOT analysis/
+# (this package), config.py (where the numbers are DEFINED), or anything
+# outside the package (tests/scripts assert literal geometry legitimately)
+_GEOMETRY_SUBPACKAGES = {"model", "data", "decode", "train", "ops",
+                         "parallel", "eval", "preprocess", "utils"}
+
+
+def _package_relative(norm: str):
+    """Path after the LAST 'fira_tpu' segment, or None. Segment-based so a
+    repo CHECKOUT directory named fira_tpu doesn't arm the rule for its
+    tests/ and scripts/ trees (substring matching did — review catch)."""
+    segs = norm.split("/")
+    for i in range(len(segs) - 1, -1, -1):
+        if segs[i] == "fira_tpu":
+            return "/".join(segs[i + 1:])
+    return None
+
+
+def check_geometry(path: str, tree: ast.AST, source: str, parents, spans,
+                   ) -> List[Finding]:
+    rel = _package_relative(astutil.normalize_path(path))
+    if rel is None or rel.split("/")[0] not in _GEOMETRY_SUBPACKAGES:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Constant) and type(node.value) is int
+                and node.value in _GEOMETRY):
+            field = _GEOMETRY[node.value]
+            findings.append(Finding(
+                path, node.lineno, "GEOMETRY-DRIFT", Severity.ERROR,
+                f"literal {node.value} shadows cfg.{field}; reference the "
+                f"named geometry so scaled configs can't silently diverge "
+                f"from the compiled shapes"))
+    return findings

@@ -1,0 +1,75 @@
+"""HOST-SYNC: host/device synchronization inside hot-loop regions.
+
+The repo's throughput story (README Design notes, BASELINE.md) depends on
+the driver never syncing with the device except at logging/dev/output
+boundaries: one stray ``.item()`` per step serializes dispatch with
+compute and erases the async-dispatch win. This rule flags every sync
+primitive inside a designated hot region (see astutil.hot_spans); the
+honest boundaries carry ``# firacheck: allow[HOST-SYNC] <reason>``.
+
+Flagged primitives:
+- ``x.item()``, ``x.block_until_ready()``
+- ``jax.device_get(x)``, ``jax.block_until_ready(x)``
+- ``np.asarray(x)`` / ``np.array(x)`` (jnp.* is device-side and exempt)
+- ``float(x)`` / ``int(x)`` / ``bool(x)`` where x is a bare
+  variable/attribute/subscript — the classic regressed ``float(loss)``.
+  Conversions of call results are not double-flagged: the inner call is
+  either itself a sync primitive (flagged once) or host-side already.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from fira_tpu.analysis import astutil
+from fira_tpu.analysis.findings import Finding, Severity
+
+_SYNC_ATTRS = {"item", "block_until_ready"}
+_SYNC_CALLS = {
+    "jax.device_get": "jax.device_get",
+    "jax.block_until_ready": "jax.block_until_ready",
+    "np.asarray": "np.asarray", "np.array": "np.array",
+    "numpy.asarray": "numpy.asarray", "numpy.array": "numpy.array",
+    "onp.asarray": "np.asarray", "onp.array": "np.array",
+}
+_CASTS = {"float", "int", "bool"}
+
+
+def _cast_arg_is_value_expr(call: ast.Call) -> bool:
+    if len(call.args) != 1 or call.keywords:
+        return False
+    arg = call.args[0]
+    if not isinstance(arg, (ast.Name, ast.Attribute, ast.Subscript)):
+        return False
+    # an argument containing a call is not double-flagged: the inner call
+    # is either itself a sync primitive (reported once) or host-side
+    return not any(isinstance(n, ast.Call) for n in ast.walk(arg))
+
+
+def check(path: str, tree: ast.AST, source: str, parents, spans,
+          ) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        region = astutil.hot_region_at(spans, node.lineno)
+        if region is None:
+            continue
+        name = astutil.call_name(node)
+        what = None
+        if name in _SYNC_CALLS:
+            what = f"{_SYNC_CALLS[name]}(...)"
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _SYNC_ATTRS and not node.args):
+            what = f".{node.func.attr}()"
+        elif name in _CASTS and _cast_arg_is_value_expr(node):
+            src = ast.unparse(node.args[0])
+            what = f"{name}({src}) on a (possible) device value"
+        if what:
+            findings.append(Finding(
+                path, node.lineno, "HOST-SYNC", Severity.ERROR,
+                f"{what} inside hot region [{region.desc}]: forces a "
+                f"host/device sync in the hot loop; move it to a "
+                f"logging/dev boundary or waive with a reason"))
+    return findings

@@ -1,0 +1,330 @@
+"""RETRACE and DONATION: compile-once and buffer-donation contracts.
+
+RETRACE — the one-compile fixed-geometry invariant (README Design notes;
+the runtime twin is the sanitizer's compile-count guard):
+- ``jax.jit(...)`` constructed inside a loop body recompiles (or at best
+  re-looks-up) every iteration;
+- a list/dict/set passed at a ``static_argnums``/``static_argnames``
+  position is unhashable → TypeError at best, cache-miss-per-call if
+  wrapped;
+- a jitted closure capturing an array built in an enclosing function bakes
+  it into the jaxpr as a constant: rebuilt closures retrace, and the
+  constant bloats the program (warning — sometimes intentional).
+
+DONATION — donated buffers die at the call (train/step.py donates the
+TrainState so the optimizer update happens in place in HBM): reading a
+variable after passing it at a donated position returns garbage or raises.
+The pass also understands this repo's factory idiom: a function whose
+return is ``jax.jit(..., donate_argnums=...)`` makes every
+``x = factory(...)`` result a donating callable, cross-module by name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from fira_tpu.analysis import astutil
+from fira_tpu.analysis.findings import Finding, Severity
+
+_ARRAY_PREFIXES = ("jnp.", "np.", "numpy.", "jax.numpy.", "jax.random.",
+                   "jax.device_put")
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+
+
+def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, ast.Tuple):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        out.append(e.value)
+                    else:
+                        return None
+                return tuple(out)
+            return None
+    return None
+
+
+def _static_spec(call: ast.Call) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    nums: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = (v.value,)
+            elif isinstance(v, ast.Tuple):
+                nums = tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, int))
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names = (v.value,)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                names = tuple(e.value for e in v.elts
+                              if isinstance(e, ast.Constant)
+                              and isinstance(e.value, str))
+    return nums, names
+
+
+def collect_donating_factories(tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    """Functions whose return value is jax.jit(..., donate_argnums=...) —
+    the engine merges these across all scanned files into one registry."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Return)
+                    and isinstance(sub.value, ast.Call)
+                    and astutil.is_jit_call(sub.value)):
+                pos = _donate_positions(sub.value)
+                if pos:
+                    out[node.name] = tuple(sorted(set(out.get(node.name, ())
+                                                      + pos)))
+    return out
+
+
+def _store_names(target: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            names.add(n.id)
+    return names
+
+
+def _resolve_value(value: ast.AST, factories: Dict[str, Tuple[int, ...]],
+                   local_factories: Dict[str, Tuple[int, ...]],
+                   ) -> Optional[Tuple[str, Tuple[int, ...]]]:
+    """Classify an assignment RHS: ('donating', pos) for a donating
+    callable, ('factory', pos) for a reference to a donating factory."""
+    if isinstance(value, ast.Call):
+        if astutil.is_jit_call(value):
+            pos = _donate_positions(value)
+            return ("donating", pos) if pos else None
+        seg = astutil.last_segment(astutil.call_name(value))
+        if seg in local_factories:
+            return ("donating", local_factories[seg])
+        if seg in factories:
+            return ("donating", factories[seg])
+        return None
+    seg = astutil.last_segment(astutil.dotted(value))
+    if seg in local_factories:
+        return ("factory", local_factories[seg])
+    if seg in factories:
+        return ("factory", factories[seg])
+    if isinstance(value, ast.IfExp):
+        a = _resolve_value(value.body, factories, local_factories)
+        b = _resolve_value(value.orelse, factories, local_factories)
+        if a and b and a[0] == b[0]:
+            return (a[0], tuple(sorted(set(a[1]) | set(b[1]))))
+    return None
+
+
+def _enclosing_loop_same_frame(node: ast.AST, parents) -> Optional[ast.AST]:
+    for a in astutil.ancestors(node, parents):
+        if isinstance(a, astutil.FunctionNode):
+            return None
+        if isinstance(a, (ast.For, ast.While)):
+            return a
+    return None
+
+
+def _check_donation_calls(path: str, tree: ast.AST, parents,
+                          donating: Dict[str, Tuple[int, ...]],
+                          findings: List[Finding]) -> None:
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in donating):
+            continue
+        positions = donating[node.func.id]
+        stmt = node
+        for a in astutil.ancestors(node, parents):
+            stmt = a
+            if isinstance(a, ast.stmt):
+                break
+        targets: Set[str] = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                targets |= _store_names(t)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets |= _store_names(stmt.target)
+        scope = astutil.enclosing_function(node, parents) or tree
+        for pos in positions:
+            if pos >= len(node.args):
+                continue
+            arg = node.args[pos]
+            if not isinstance(arg, ast.Name):
+                continue
+            var = arg.id
+            if var in targets:
+                continue  # rebound by the donating call itself
+            loop = _enclosing_loop_same_frame(node, parents)
+            if loop is not None:
+                findings.append(Finding(
+                    path, node.lineno, "DONATION", Severity.ERROR,
+                    f"`{var}` is donated to `{node.func.id}` (argument "
+                    f"{pos}) inside a loop without being rebound by the "
+                    f"call — the next iteration passes an "
+                    f"already-donated buffer"))
+                continue
+            first_store = None
+            first_read = None
+            for n in ast.walk(scope):
+                if isinstance(n, ast.Name) and n.id == var \
+                        and n.lineno > node.lineno:
+                    if isinstance(n.ctx, ast.Store):
+                        if first_store is None or n.lineno < first_store:
+                            first_store = n.lineno
+                    elif first_read is None or n.lineno < first_read:
+                        first_read = n.lineno
+            if first_read is not None and (first_store is None
+                                           or first_read <= first_store):
+                findings.append(Finding(
+                    path, node.lineno, "DONATION", Severity.ERROR,
+                    f"`{var}` is donated to `{node.func.id}` (argument "
+                    f"{pos}) but read again at line {first_read}; donated "
+                    f"buffers are invalidated by the call"))
+
+
+def _free_names(fn: ast.AST) -> Set[str]:
+    bound: Set[str] = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        bound.add(a.arg)
+    loads: Set[str] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Store):
+                    bound.add(n.id)
+                else:
+                    loads.add(n.id)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(n.name)
+    return loads - bound
+
+
+def _check_closure_capture(path: str, fn: ast.AST, parents,
+                           findings: List[Finding], label: str) -> None:
+    free = _free_names(fn)
+    if not free:
+        return
+    enclosing = astutil.enclosing_function(fn, parents)
+    while enclosing is not None:
+        body = (enclosing.body if isinstance(enclosing.body, list)
+                else [enclosing.body])
+        for stmt in body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            names = set()
+            for t in stmt.targets:
+                names |= _store_names(t)
+            hit = names & free
+            if not hit or not isinstance(stmt.value, ast.Call):
+                continue
+            vname = astutil.call_name(stmt.value)
+            if vname and vname.startswith(_ARRAY_PREFIXES):
+                var = sorted(hit)[0]
+                findings.append(Finding(
+                    path, fn.lineno, "RETRACE", Severity.WARNING,
+                    f"{label} captures array `{var}` (built at line "
+                    f"{stmt.lineno}) as a closure constant; it is baked "
+                    f"into the jaxpr — pass it as an argument so the "
+                    f"compiled program is reused"))
+        enclosing = astutil.enclosing_function(enclosing, parents)
+
+
+def check(path: str, tree: ast.AST, source: str, parents, spans, *,
+          factories: Dict[str, Tuple[int, ...]],
+          ) -> List[Finding]:
+    findings: List[Finding] = []
+    func_defs: Dict[str, ast.AST] = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    local_factories = collect_donating_factories(tree)
+    donating: Dict[str, Tuple[int, ...]] = {}
+    jit_static: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {}
+
+    for node in ast.walk(tree):
+        # --- RETRACE (a): jit constructed inside a loop body ---
+        if isinstance(node, ast.Call) and astutil.is_jit_call(node):
+            loop = _enclosing_loop_same_frame(node, parents)
+            if loop is not None:
+                findings.append(Finding(
+                    path, node.lineno, "RETRACE", Severity.ERROR,
+                    f"jax.jit constructed inside the loop at line "
+                    f"{loop.lineno}: every iteration builds a fresh jitted "
+                    f"callable (retrace/cache-miss per step); hoist it out "
+                    f"of the loop"))
+            # RETRACE (c): jitted lambda / local def capturing arrays
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    _check_closure_capture(path, arg, parents, findings,
+                                           "jitted lambda")
+                elif isinstance(arg, ast.Name) and arg.id in func_defs:
+                    _check_closure_capture(
+                        path, func_defs[arg.id], parents, findings,
+                        f"jitted function `{arg.id}`")
+
+        # --- collect donating/static callables from assignments ---
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            tname = node.targets[0].id
+            resolved = _resolve_value(node.value, factories, local_factories)
+            if resolved:
+                kind, pos = resolved
+                if kind == "donating":
+                    donating[tname] = pos
+                else:
+                    local_factories[tname] = pos
+            if isinstance(node.value, ast.Call) \
+                    and astutil.is_jit_call(node.value):
+                nums, names = _static_spec(node.value)
+                if nums or names:
+                    jit_static[tname] = (nums, names)
+
+    # --- RETRACE (b): unhashable values at static positions ---
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in jit_static):
+            continue
+        nums, names = jit_static[node.func.id]
+        for pos in nums:
+            if pos < len(node.args) and isinstance(node.args[pos],
+                                                   _UNHASHABLE):
+                findings.append(Finding(
+                    path, node.lineno, "RETRACE", Severity.ERROR,
+                    f"unhashable {type(node.args[pos]).__name__} passed at "
+                    f"static_argnums position {pos} of "
+                    f"`{node.func.id}`: static arguments are hashed for "
+                    f"the jit cache"))
+        for kw in node.keywords:
+            if kw.arg in names and isinstance(kw.value, _UNHASHABLE):
+                findings.append(Finding(
+                    path, node.lineno, "RETRACE", Severity.ERROR,
+                    f"unhashable {type(kw.value).__name__} passed at "
+                    f"static_argnames key '{kw.arg}' of `{node.func.id}`"))
+
+    # jit-decorated local defs also get the closure-capture check
+    for fname, fn in func_defs.items():
+        for dec in fn.decorator_list:
+            if ((isinstance(dec, ast.Call) and astutil.is_jit_call(dec))
+                    or astutil.dotted(dec) in ("jax.jit", "jit")):
+                _check_closure_capture(path, fn, parents, findings,
+                                       f"jit-decorated `{fname}`")
+
+    _check_donation_calls(path, tree, parents, donating, findings)
+    return findings

@@ -1,0 +1,180 @@
+"""Runtime sanitizer: the dynamic twin of firacheck's static rules.
+
+``--sanitize`` on the train/test CLIs arms three checks for the whole run:
+
+- ``jax_debug_nans`` / ``jax_debug_infs``: every jitted program is
+  re-checked for non-finite outputs (JAX re-runs op-by-op on a hit, so the
+  raise points at the culprit primitive). Costs a sync per dispatch —
+  this is a debugging mode, not a training mode.
+- compile capture: ``jax_log_compiles`` routes one "Compiling <name>..."
+  log record per XLA compilation through :class:`CompileWatcher`;
+- :class:`CompileGuard`: the one-compile fixed-geometry contract
+  (README Design notes; static twin: RETRACE). Call ``guard.step(label)``
+  after each dispatch of a program; a label's FIRST step may compile
+  (warmup), any compilation attributed to a later step of a known label
+  raises :class:`RetraceError` with the captured program names.
+
+The guard is deliberately per-label, not global: a fused-steps run
+legitimately compiles the grouped program at step 1 and the per-step
+program at the epoch tail; each label gets exactly one warmup dispatch.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import logging
+from typing import Dict, Iterator, Optional
+
+_COMPILE_LOGGERS = (
+    "jax._src.interpreters.pxla",  # "Compiling <fn> with global shapes..."
+    "jax._src.dispatch",           # "Finished XLA compilation of <fn>..."
+)
+_COMPILE_PREFIXES = ("Compiling ",)
+
+
+class RetraceError(RuntimeError):
+    """A post-warmup step triggered a fresh XLA compilation."""
+
+
+class CompileWatcher(logging.Handler):
+    """Counts XLA compilations by listening to jax's log_compiles records.
+
+    Host-side only: reading ``count`` never touches the device. The
+    messages are also kept (most recent first-N) so a RetraceError can
+    name the program that recompiled.
+    """
+
+    def __init__(self, keep: int = 20) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.count = 0
+        # most-recent `keep` messages: a RetraceError must name the program
+        # that JUST recompiled, not a warmup-era one
+        self.messages: collections.deque = collections.deque(maxlen=keep)
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:  # a malformed record must never kill a train run
+            return
+        if msg.startswith(_COMPILE_PREFIXES):
+            self.count += 1
+            # first clause of the message names the compiled program
+            self.messages.append(msg.split(" with ")[0])
+
+
+@dataclasses.dataclass
+class CompileGuard:
+    """Per-program-label compile budget: 1 warmup dispatch, then zero."""
+
+    watcher: CompileWatcher
+    _last_count: int = 0
+    _extra: int = 0
+    _seen: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def step_counting(self, label: str) -> int:
+        """Attribute compilations since the last call to ``label``'s
+        current dispatch and record them; returns the number of
+        post-warmup compilations attributed to this dispatch."""
+        new = self.watcher.count - self._last_count
+        self._last_count = self.watcher.count
+        steps = self._seen.get(label, 0)
+        self._seen[label] = steps + 1
+        extra = new if steps >= 1 else 0
+        self._extra += extra
+        return extra
+
+    def step(self, label: str) -> None:
+        """step_counting + raise: the drivers' per-dispatch check."""
+        extra = self.step_counting(label)
+        if extra:
+            recent = "; ".join(list(self.watcher.messages)[-min(extra, 5):])
+            raise RetraceError(
+                f"sanitizer: {extra} new XLA compilation(s) at step "
+                f"{self._seen[label]} of program '{label}' — the "
+                f"one-compile fixed-geometry invariant is broken (shape "
+                f"drift or a re-constructed jit). Recent compiles: "
+                f"{recent}")
+
+    def compiles_after_warmup(self) -> int:
+        """Total compilations attributed past some label's warmup step —
+        0 on a healthy run (the compile-count regression test pins this
+        without needing the raise path)."""
+        return self._extra
+
+
+@contextlib.contextmanager
+def compile_capture() -> Iterator[CompileWatcher]:
+    """Arm jax_log_compiles and attach the counting handler; restores
+    both on exit. Usable standalone (tests) or via :func:`sanitize`."""
+    import jax
+
+    watcher = CompileWatcher()
+    loggers = [logging.getLogger(name) for name in _COMPILE_LOGGERS]
+    prev_levels = [lg.level for lg in loggers]
+    prev_flag = jax.config.jax_log_compiles
+    jax.config.update("jax_log_compiles", True)
+    for lg in loggers:
+        lg.addHandler(watcher)
+        # the record must reach our handler even under a quiet root config;
+        # the EFFECTIVE level is what gates isEnabledFor (an unset logger
+        # inherits a root ERROR config and would drop WARNING records)
+        if lg.getEffectiveLevel() > logging.WARNING:
+            lg.setLevel(logging.WARNING)
+    try:
+        yield watcher
+    finally:
+        for lg, lvl in zip(loggers, prev_levels):
+            lg.removeHandler(watcher)
+            lg.setLevel(lvl)
+        jax.config.update("jax_log_compiles", prev_flag)
+
+
+def arm(enabled: bool = True, *, nans: bool = True, infs: bool = True,
+        ) -> Optional[CompileGuard]:
+    """Process-lifetime arming — CLI-ONLY (fira_tpu/cli.py). Mutates global
+    jax config and logger state with no teardown, which is fine exactly
+    when the process dies with the run. Library callers and tests must use
+    the :func:`sanitize` context manager and pass the resulting guard into
+    train()/run_test() instead."""
+    if not enabled:
+        return None
+    import jax
+
+    jax.config.update("jax_debug_nans", nans)
+    jax.config.update("jax_debug_infs", infs)
+    jax.config.update("jax_log_compiles", True)
+    watcher = CompileWatcher()
+    for name in _COMPILE_LOGGERS:
+        lg = logging.getLogger(name)
+        lg.addHandler(watcher)
+        if lg.getEffectiveLevel() > logging.WARNING:
+            lg.setLevel(logging.WARNING)
+    return CompileGuard(watcher)
+
+
+@contextlib.contextmanager
+def sanitize(enabled: bool = True, *, nans: bool = True, infs: bool = True,
+             ) -> Iterator[Optional[CompileGuard]]:
+    """Arm the full sanitizer; yields a CompileGuard (None when disabled).
+
+    The drivers thread the guard through their dispatch sites:
+    ``train/loop.py`` labels per-step/grouped/dev programs,
+    ``decode/runner.py`` labels the beam program.
+    """
+    if not enabled:
+        yield None
+        return
+    import jax
+
+    prev_nans = jax.config.jax_debug_nans
+    prev_infs = jax.config.jax_debug_infs
+    jax.config.update("jax_debug_nans", nans)
+    jax.config.update("jax_debug_infs", infs)
+    try:
+        with compile_capture() as watcher:
+            yield CompileGuard(watcher)
+    finally:
+        jax.config.update("jax_debug_nans", prev_nans)
+        jax.config.update("jax_debug_infs", prev_infs)

@@ -125,6 +125,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="train: write a jax.profiler trace of a steady-state "
                         "step window here (TensorBoard-loadable)")
+    p.add_argument("--sanitize", action="store_true",
+                   help="arm the runtime sanitizer (analysis.sanitizer): "
+                        "jax_debug_nans/jax_debug_infs on every program, "
+                        "plus a compile-count guard that raises if any "
+                        "step after a program's warmup dispatch triggers "
+                        "a new XLA compilation (catches silent per-step "
+                        "retraces). Debugging mode: each dispatch syncs, "
+                        "so throughput numbers are not meaningful")
     p.add_argument("--perf", default=None, choices=["parity", "production"],
                    help="knob preset: 'production' applies the measured "
                         "fastest TPU config (config.PRODUCTION_PERF_KNOBS: "
@@ -236,6 +244,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     suffix = f"_{args.ablation}" if args.ablation else ""
     ckpt_dir = args.ckpt_dir or os.path.join(args.out_dir, f"ckpt{suffix}")
 
+    # --sanitize: process-lifetime arming is correct here and ONLY here —
+    # the CLI process dies with the run (library callers use the
+    # sanitizer.sanitize() context manager instead, which restores config)
+    from fira_tpu.analysis import sanitizer as sanitizer_lib
+
+    guard = sanitizer_lib.arm(args.sanitize)
+
     if args.command == "train":
         from fira_tpu.train.loop import train
 
@@ -244,6 +259,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             dataset, cfg, mesh=mesh, out_dir=args.out_dir,
             ckpt_dir=ckpt_dir, epochs=args.epochs, var_maps=var_maps,
             resume=not args.no_resume, profile_dir=args.profile_dir,
+            guard=guard,
         )
         print(f"best dev bleu: {result.best_bleu:.4f}  "
               f"throughput: {result.commits_per_sec_per_chip:.1f} "
@@ -282,7 +298,8 @@ def main(argv: Optional[List[str]] = None) -> int:
               "decoding the LATEST training state", file=sys.stderr)
         params = ckpt.restore_latest(template)[0].params
     metrics = run_test(model, params, dataset, cfg, out_dir=args.out_dir,
-                       ablation=args.ablation, var_maps=var_maps)
+                       ablation=args.ablation, var_maps=var_maps,
+                       guard=guard)
     print(f"test sentence-bleu: {metrics['sentence_bleu']:.4f} "
           f"({int(metrics['n'])} commits) -> "
           f"{os.path.join(args.out_dir, output_name(args.ablation))}")

@@ -34,7 +34,12 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
              out_dir: str = "OUTPUT",
              ablation: Optional[str] = None,
              var_maps: Optional[List[Dict[str, str]]] = None,
-             split: str = "test") -> Dict[str, float]:
+             split: str = "test",
+             guard=None) -> Dict[str, float]:
+    """``guard``: an armed analysis.sanitizer.CompileGuard — the beam
+    program must compile exactly once (warmup), then never again. The CLI
+    arms it via ``--sanitize``; library callers use the
+    sanitizer.sanitize() context manager so global config is restored."""
     cfg = cfg or dataset.cfg
     data = dataset.splits[split]
     vocab = dataset.word_vocab
@@ -52,9 +57,12 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
     with open(partial_path, "w") as out_f:
         for batch in epoch_batches(data, cfg, batch_size=cfg.test_batch_size):
             tokens, probs = beam(params, batch)
+            # firacheck: allow[HOST-SYNC] per-batch output collection IS the decode boundary: beams must reach the host to be cooked into text
             tokens = np.asarray(jax.device_get(tokens))
-            probs = np.asarray(jax.device_get(probs))
-            valid = np.asarray(batch["valid"])
+            probs = np.asarray(jax.device_get(probs))  # firacheck: allow[HOST-SYNC] same decode output boundary as the line above
+            if guard is not None:
+                guard.step("beam_search")
+            valid = batch["valid"]  # host-side numpy batch field, no sync
             for i in range(tokens.shape[0]):
                 if not valid[i]:
                     continue

@@ -64,7 +64,7 @@ class TrainLog:
 
 def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
             var_maps: Optional[List[Dict[str, str]]] = None,
-            split: str = "valid") -> tuple[float, str]:
+            split: str = "valid", guard=None) -> tuple[float, str]:
     """Greedy teacher-forced validation (run_model.py:118-184). Returns
     (mean sentence BLEU over the split, dev_output text)."""
     data = dataset.splits[split]
@@ -74,8 +74,11 @@ def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
     out_lines = []
     cursor = 0
     for batch in epoch_batches(data, cfg, batch_size=cfg.test_batch_size):
+        # firacheck: allow[HOST-SYNC] dev gate IS a designated sync boundary: teacher-forced ids must reach the host for BLEU scoring (README Design notes)
         ids = np.asarray(jax.device_get(dev_step(params, batch)))
-        valid = np.asarray(batch["valid"])
+        valid = batch["valid"]  # host-side numpy batch field, no device trip
+        if guard is not None:
+            guard.step("dev_step")
         for i in range(ids.shape[0]):
             if not valid[i]:
                 continue
@@ -98,6 +101,7 @@ def _materialize(x) -> None:
     NOT a sync on some remote PJRT backends — it acks before execution
     finishes (scripts/tpu_sync_check.py), which would close throughput-meter
     intervals early and inflate commits/sec up to 20x."""
+    # firacheck: allow[HOST-SYNC] THE designated sync helper: every hot-loop sync funnels through here so the boundaries stay enumerable (called only at meter/log/epoch edges)
     np.asarray(jax.device_get(x))
 
 
@@ -118,9 +122,17 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
           resume: bool = True,
           profile_dir: Optional[str] = None,
           profile_steps: int = 10,
+          guard=None,
           dtype=None) -> TrainResult:
     """Full training run. ``mesh=None`` => single-chip jit; otherwise the
-    (data, model) mesh from parallel.mesh with XLA-inserted collectives."""
+    (data, model) mesh from parallel.mesh with XLA-inserted collectives.
+
+    ``guard``: an armed analysis.sanitizer.CompileGuard — each dispatch
+    site labels its program and a post-warmup step that triggers a new XLA
+    compilation raises RetraceError. The CLI arms process-wide via
+    ``--sanitize`` (sanitizer.arm); library callers wrap the call in
+    ``with sanitizer.sanitize() as guard:`` so global config is restored.
+    """
     import jax.numpy as jnp
 
     cfg = cfg or dataset.cfg  # dataset.cfg has vocab sizes filled in
@@ -254,7 +266,7 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                 sync_tick()
                 meter.pause()  # dev time is not train time
                 cur_bleu, dev_text = run_dev(dev_step, state.params, dataset,
-                                             cfg, var_maps)
+                                             cfg, var_maps, guard=guard)
                 better = cur_bleu > best_bleu
                 log.gate(epoch, idx, cur_bleu, better)
                 if better:
@@ -278,14 +290,19 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                 state, metrics = grouped_step(state, batch)
             else:
                 state, metrics = train_step(state, batch)
+            if guard is not None:
+                # compile-once contract: a post-warmup dispatch of either
+                # program that recompiles raises RetraceError here
+                guard.step("grouped_step" if stacked else "train_step")
             # a fused group is k steps; an accumulation group is ONE step
             global_step += 1 if (stacked and accum > 1) else k
             last_metrics = metrics
             pending_commits += n_valid
             if log_due:
                 # blocks; a stacked dispatch reports its last step's loss
+                # firacheck: allow[HOST-SYNC] the 10-batch console-log cadence is a designated sync boundary (README Design notes); steps in between stay async-dispatched
                 loss = float(np.asarray(
-                    jax.device_get(metrics["loss"])).ravel()[-1])
+                    jax.device_get(metrics["loss"])).ravel()[-1])  # firacheck: allow[HOST-SYNC] same log boundary — the expression's device_get continues onto this line
                 sync_tick()
                 log.console(f"epoch: {epoch} batch: {idx} loss: {loss:.4f}")
             idx += k

@@ -113,8 +113,13 @@ class CheckpointManager:
         # failure from being misread as "old checkpoint" and silently
         # mislabelled threefry (advisor r3).
         meta_t = {"best_bleu": 0.0, "epoch": 0, "rng_impl": "threefry"}
-        saved_meta_keys = (self._ckpt.metadata(self._path(self.LATEST))
-                           .item_metadata.tree.get("meta", {}))
+        # orbax changed the metadata() return shape across versions: older
+        # releases hand back the metadata tree as a plain dict, newer ones
+        # wrap it in CheckpointMetadata.item_metadata.tree
+        meta_obj = self._ckpt.metadata(self._path(self.LATEST))
+        if hasattr(meta_obj, "item_metadata"):
+            meta_obj = meta_obj.item_metadata.tree
+        saved_meta_keys = (meta_obj or {}).get("meta", {})
         if "rng_impl" not in saved_meta_keys:
             del meta_t["rng_impl"]
         payload = self._ckpt.restore(

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Single entry point for the repo's machine-enforced gates:
+#   1. firacheck — the JAX-hazard static analyzer (docs/ANALYSIS.md);
+#      exits nonzero on any unsuppressed error finding.
+#   2. tier-1 pytest — the ROADMAP.md verify command, verbatim.
+# Usage: bash scripts/check.sh   (from the repo root; CI calls exactly this)
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== firacheck: static JAX-hazard scan =="
+JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check fira_tpu tests scripts || exit $?
+
+echo "== tier-1 pytest (ROADMAP.md verify, verbatim) =="
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,207 @@
+"""Planted-hazard corpus for firacheck (tests/test_firacheck.py).
+
+NEVER imported — scanned as text. Every line carrying ``HAZARD[RULE-ID]``
+(in a plain comment or inside an allow-reason) must produce exactly that
+finding; lines whose allow-reason says SILENCED must produce none. The
+golden test derives the expected finding set from these markers, so lines
+can move freely.
+
+Directory walks skip ``fixtures/`` (engine.iter_py_files) — these hazards
+are live on purpose and must not dirty the repo self-scan.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _consume(*args):
+    return args
+
+
+# --- HOST-SYNC: sync primitives inside traced/hot regions ---------------
+
+def scan_body(carry, x):
+    hostval = float(carry)  # HAZARD[HOST-SYNC] float() on the carry
+    arr = np.asarray(x)  # HAZARD[HOST-SYNC] np.asarray on a tracer
+    got = jax.device_get(x)  # HAZARD[HOST-SYNC] device_get in scan body
+    ok_dev = jnp.asarray(x)  # control: jnp.asarray is device-side, no sync
+    return carry + x, _consume(hostval, arr, got, ok_dev)
+
+
+def run_scan(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)
+
+
+@jax.jit
+def jitted_sync(x):
+    return x.item()  # HAZARD[HOST-SYNC] .item() in a jitted function
+
+
+def scan_body_waived(carry, x):
+    # firacheck: allow[HOST-SYNC] SILENCED planted twin - the waiver must swallow exactly this rule on this line
+    v = float(carry)
+    return carry, v
+
+
+def run_scan_waived(xs):
+    return jax.lax.scan(scan_body_waived, 0.0, xs)
+
+
+def scan_body_wrong_waiver(carry, x):
+    v = int(carry)  # firacheck: allow[DISCARDED-AT] HAZARD[HOST-SYNC] a DISCARDED-AT waiver must NOT silence HOST-SYNC
+    return carry, v
+
+
+def run_scan_wrong_waiver(xs):
+    return jax.lax.scan(scan_body_wrong_waiver, 0.0, xs)
+
+
+# --- RETRACE: jit-in-loop / unhashable statics / closure capture --------
+
+def model_fn(p, b):
+    return p * b
+
+
+def retrace_in_loop(batches):
+    outs = []
+    for b in batches:
+        step = jax.jit(model_fn)  # HAZARD[RETRACE] fresh jit per iteration
+        outs.append(step(1.0, b))
+    return outs
+
+
+def shaped_fn(x, shape):
+    return x.reshape(shape)
+
+
+reshaper = jax.jit(shaped_fn, static_argnums=(1,))
+
+
+def retrace_unhashable(x):
+    return reshaper(x, [2, 2])  # HAZARD[RETRACE] list at a static position
+
+
+def retrace_closure(x):
+    table = jnp.arange(8)
+
+    def lookup(i):  # HAZARD[RETRACE] closure bakes `table` into the jaxpr
+        return table[i]
+
+    return jax.jit(lookup)(x)
+
+
+def retrace_loop_waived(batches):
+    outs = []
+    for b in batches:
+        # firacheck: allow[RETRACE] SILENCED planted twin for the jit-in-loop hazard
+        step = jax.jit(model_fn)
+        outs.append(step(1.0, b))
+    return outs
+
+
+# --- DONATION: reads after donating calls -------------------------------
+
+def update(state, batch):
+    return state + batch
+
+
+donating_step = jax.jit(update, donate_argnums=(0,))
+
+
+def make_step():
+    """Factory idiom: callers of make_step() get a donating callable."""
+    return jax.jit(update, donate_argnums=(0,))
+
+
+def donation_read_after(state, batch):
+    new_state = donating_step(state, batch)  # HAZARD[DONATION] `state` read below
+    return new_state + state
+
+
+def donation_via_factory(params, xs):
+    step = make_step()
+    fresh = step(params, xs)  # HAZARD[DONATION] factory-made donator, `params` read below
+    return fresh, params
+
+
+def donation_in_loop(state, batches):
+    outs = []
+    for b in batches:
+        outs.append(donating_step(state, b))  # HAZARD[DONATION] not rebound in loop
+    return outs
+
+
+def donation_ok(state, batches):
+    for b in batches:
+        state, _ = _consume(donating_step(state, b), None)  # control: rebound
+    return state
+
+
+def donation_rebound(state, batch):
+    state = donating_step(state, batch)  # control: rebinding read is safe
+    return state
+
+
+# --- PRNG-REUSE ---------------------------------------------------------
+
+def prng_reuse(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))  # HAZARD[PRNG-REUSE] key consumed twice
+    return a + b
+
+
+def prng_ok(key):
+    k1, k2 = jax.random.split(key)  # control: split before each consumer
+    a = jax.random.normal(k1, (2,))
+    b = jax.random.uniform(k2, (2,))
+    return a + b
+
+
+def prng_fold_ok(key):
+    a = jax.random.normal(jax.random.fold_in(key, 0), (2,))
+    b = jax.random.normal(jax.random.fold_in(key, 1), (2,))  # control: fold_in derives
+    return a + b
+
+
+def prng_reuse_waived(key):
+    a = jax.random.normal(key, (2,))
+    # firacheck: allow[PRNG-REUSE] SILENCED planted twin for the reuse hazard
+    b = jax.random.uniform(key, (2,))
+    return a + b
+
+
+# --- DISCARDED-AT -------------------------------------------------------
+
+def discarded_at(x):
+    x.at[0].set(1.0)  # HAZARD[DISCARDED-AT] functional update discarded
+    return x
+
+
+def assigned_at_ok(x):
+    x = x.at[0].add(2.0)  # control: result assigned
+    return x
+
+
+# --- GEOMETRY-DRIFT (armed only under the test's virtual fira_tpu path) -
+
+def geometry_drift(tokens):
+    window = tokens[:650]  # HAZARD[GEOMETRY-DRIFT] re-typed graph_len
+    msg = tokens[:30]  # HAZARD[GEOMETRY-DRIFT] re-typed tar_len
+    return window, msg
+
+
+def geometry_waived(tokens):
+    # firacheck: allow[GEOMETRY-DRIFT] SILENCED planted twin for the literal-shape hazard
+    return tokens[:210]
+
+
+def geometry_ok(tokens, cfg):
+    return tokens[: cfg.graph_len]  # control: named geometry referenced
+
+
+# --- BAD-SUPPRESS: reason-less waiver (found by regex in the test) ------
+
+def reasonless_waiver(x):
+    # firacheck: allow[PRNG-REUSE]
+    return x

@@ -1,0 +1,262 @@
+"""firacheck static-analyzer tests.
+
+Three contracts:
+- every shipped rule FIRES: the planted-hazard fixture
+  (tests/fixtures/firacheck_hazards.py) marks each hazard line with
+  ``HAZARD[RULE-ID]`` and the golden set is derived from those markers, so
+  the fixture can be edited without renumbering;
+- every rule is SUPPRESSIBLE and suppression is rule-exact: allow-reasons
+  containing SILENCED must swallow their finding, and a waiver naming the
+  wrong rule must swallow nothing;
+- the repo itself is CLEAN: the self-scan over fira_tpu/tests/scripts
+  (with the committed waiver baseline) exits 0 — the tier-1 gate that
+  makes the performance invariants machine-enforced for every future PR.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from fira_tpu.analysis import cli as firacheck_cli
+from fira_tpu.analysis import engine
+from fira_tpu.analysis.findings import RULES, Severity
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "firacheck_hazards.py")
+# virtual path: arms the fira_tpu-scoped GEOMETRY-DRIFT rule while keeping
+# the hot-region logic identical (not a designated driver file)
+VIRTUAL_PATH = "fira_tpu/model/firacheck_hazards.py"
+
+_MARKER = re.compile(r"HAZARD\[([A-Z-]+)\]")
+
+
+def _fixture_source():
+    with open(FIXTURE) as f:
+        return f.read()
+
+
+def _expected_markers(source):
+    out = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        for rule in _MARKER.findall(line):
+            if rule in RULES:  # skips the docstring's HAZARD[RULE-ID] example
+                out.add((rule, i))
+    return out
+
+
+def test_every_rule_fires_and_matches_golden_markers():
+    source = _fixture_source()
+    expected = _expected_markers(source)
+    findings = engine.check_source(VIRTUAL_PATH, source)
+    actual = {(f.rule, f.line) for f in findings if f.rule != "BAD-SUPPRESS"}
+    assert actual == expected, (
+        f"unexpected: {sorted(actual - expected)}; "
+        f"missing: {sorted(expected - actual)}")
+    # the fixture covers every shipped rule (BAD-SUPPRESS and PARSE-ERROR
+    # have their own dedicated tests below)
+    fired = {rule for rule, _ in actual}
+    assert fired == set(RULES) - {"BAD-SUPPRESS", "PARSE-ERROR"}
+
+
+def test_geometry_scope_is_package_segment_based(tmp_path):
+    """A repo CHECKOUT directory named fira_tpu must not arm the rule for
+    its tests/ tree; the real package sub-dirs must still arm."""
+    src = "LIMIT = 650\n"
+    tests_dir = tmp_path / "fira_tpu" / "tests"
+    tests_dir.mkdir(parents=True)
+    (tests_dir / "test_x.py").write_text(src)
+    assert not engine.check_paths([str(tests_dir / "test_x.py")])
+    pkg_dir = tmp_path / "fira_tpu" / "fira_tpu" / "model"
+    pkg_dir.mkdir(parents=True)
+    (pkg_dir / "m.py").write_text(src)
+    found = engine.check_paths([str(pkg_dir / "m.py")])
+    assert [f.rule for f in found] == ["GEOMETRY-DRIFT"]
+
+
+def test_unparseable_file_gates_as_error():
+    findings = engine.check_source("pkg/broken.py", "def broken(:\n")
+    assert [f.rule for f in findings] == ["PARSE-ERROR"]
+    assert findings[0].severity is Severity.ERROR
+
+
+def test_silenced_twins_are_suppressed_but_fire_raw():
+    source = _fixture_source()
+    silenced_lines = {
+        i + 1  # the standalone waiver targets the NEXT code line
+        for i, line in enumerate(source.splitlines(), start=1)
+        if "SILENCED" in line and "firacheck: allow[" in line
+    }
+    assert silenced_lines, "fixture lost its SILENCED twins"
+    suppressed = engine.check_source(VIRTUAL_PATH, source)
+    raw = engine.check_source(VIRTUAL_PATH, source, suppress=False)
+    suppressed_lines = {f.line for f in suppressed
+                        if f.rule != "BAD-SUPPRESS"}
+    raw_lines = {f.line for f in raw if f.rule != "BAD-SUPPRESS"}
+    for line in silenced_lines:
+        assert line not in suppressed_lines, (
+            f"waiver on line {line - 1} did not silence its finding")
+        assert line in raw_lines, (
+            f"SILENCED twin near line {line} stopped firing raw — the "
+            f"waiver now waives nothing")
+
+
+def test_wrong_rule_waiver_silences_nothing():
+    source = _fixture_source()
+    (line,) = [i for i, text in
+               enumerate(source.splitlines(), start=1)
+               if "a DISCARDED-AT waiver must NOT silence" in text]
+    findings = engine.check_source(VIRTUAL_PATH, source)
+    assert any(f.rule == "HOST-SYNC" and f.line == line for f in findings)
+    # ... and the mismatched waiver is reported as unused
+    assert any(f.rule == "BAD-SUPPRESS" and f.line == line
+               and f.severity is Severity.WARNING for f in findings)
+
+
+def test_reasonless_waiver_is_an_error():
+    source = _fixture_source()
+    (line,) = [i for i, text in
+               enumerate(source.splitlines(), start=1)
+               if re.search(r"firacheck: allow\[PRNG-REUSE\]\s*$", text)]
+    findings = engine.check_source(VIRTUAL_PATH, source)
+    assert any(f.rule == "BAD-SUPPRESS" and f.line == line
+               and f.severity is Severity.ERROR for f in findings)
+
+
+def test_donation_factory_registry_is_cross_file(tmp_path):
+    (tmp_path / "factory.py").write_text(
+        "import jax\n"
+        "def jit_step(fn):\n"
+        "    return jax.jit(fn, donate_argnums=(0,))\n")
+    (tmp_path / "driver.py").write_text(
+        "import factory\n"
+        "def run(state, batch):\n"
+        "    step = factory.jit_step(lambda s, b: s)\n"
+        "    new = step(state, batch)\n"
+        "    return new, state\n")
+    findings = engine.check_paths([str(tmp_path)])
+    don = [f for f in findings if f.rule == "DONATION"]
+    assert len(don) == 1 and don[0].path.endswith("driver.py")
+
+
+def test_driver_loop_designation_is_path_scoped():
+    source = ("def drive(step, batches):\n"
+              "    for b in batches:\n"
+              "        s, m = step(None, b)\n"
+              "        loss = float(m)\n")
+    hot = engine.check_source("fira_tpu/train/loop.py", source)
+    cold = engine.check_source("somepkg/driver.py", source)
+    assert any(f.rule == "HOST-SYNC" for f in hot)
+    assert not cold
+
+
+def test_path_scoping_survives_subdirectory_cwd(monkeypatch):
+    """Rule scoping normalizes to absolute paths: invoking the checker
+    from inside the package must not silently disarm the driver rules."""
+    source = ("def drive(step, batches):\n"
+              "    for b in batches:\n"
+              "        s, m = step(None, b)\n"
+              "        loss = float(m)\n")
+    monkeypatch.chdir(os.path.join(REPO_ROOT, "fira_tpu"))
+    hot = engine.check_source("train/loop.py", source)
+    assert any(f.rule == "HOST-SYNC" for f in hot)
+
+
+def test_prng_reuse_is_branch_aware():
+    """Mutually exclusive if/else consumers of one key are NOT reuse;
+    a third consumer after the branch IS."""
+    exclusive_only = (
+        "import jax\n"
+        "def sample(key, train):\n"
+        "    if train:\n"
+        "        x = jax.random.bernoulli(key, 0.5)\n"
+        "    else:\n"
+        "        x = jax.random.uniform(key)\n"
+        "    return x\n")
+    assert not engine.check_source("pkg/m.py", exclusive_only)
+    with_tail_use = exclusive_only.replace(
+        "    return x\n",
+        "    y = jax.random.normal(key, (2,))\n    return x + y\n")
+    findings = engine.check_source("pkg/m.py", with_tail_use)
+    assert [f.rule for f in findings] == ["PRNG-REUSE"]
+
+
+def test_multi_rule_waiver_reports_stale_half():
+    """allow[A,B] where only A matches must flag B as unused."""
+    source = (
+        "import jax\n"
+        "def body(c, x):\n"
+        "    # firacheck: allow[HOST-SYNC,RETRACE] boundary reason here\n"
+        "    v = float(c)\n"
+        "    return c, v\n"
+        "def run(xs):\n"
+        "    return jax.lax.scan(body, 0.0, xs)\n")
+    findings = engine.check_source("pkg/mod.py", source)
+    assert not any(f.rule == "HOST-SYNC" for f in findings)  # A waived
+    stale = [f for f in findings if f.rule == "BAD-SUPPRESS"]
+    assert len(stale) == 1 and "RETRACE" in stale[0].message \
+        and "HOST-SYNC" not in stale[0].message
+
+
+def test_cli_format_exit_codes_and_fixture_walk_skip(capsys):
+    # explicit file: scanned, hazards -> exit 1, stable output format
+    rc = firacheck_cli.main(["check", "--quiet", FIXTURE])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 1 and out
+    pattern = re.compile(r"^.+:\d+ \[[A-Z-]+\] (error|warning): .+$")
+    for line in out:
+        assert pattern.match(line), line
+    # directory walk: fixtures/ is pruned from parent walks, so the tests
+    # tree's planted hazards don't dirty the self-scan
+    files = engine.iter_py_files([os.path.dirname(os.path.dirname(FIXTURE))])
+    assert FIXTURE not in files
+    assert any(f.endswith("test_firacheck.py") for f in files)
+
+
+def test_empty_or_mistyped_path_gates(capsys, tmp_path):
+    """`check fira_tpuu` (typo) must NOT exit 0 over 0 files."""
+    assert firacheck_cli.main(["check", "--quiet",
+                               str(tmp_path / "no_such_dir")]) == 1
+    err = capsys.readouterr().err
+    assert "no Python files" in err
+
+
+def test_list_rules_covers_registry(capsys):
+    assert firacheck_cli.main(["list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in RULES:
+        assert rule in out
+
+
+def test_docs_cover_every_rule():
+    """docs/ANALYSIS.md and the rule registry cannot drift apart."""
+    with open(os.path.join(REPO_ROOT, "docs", "ANALYSIS.md")) as f:
+        doc = f.read()
+    for rule in RULES:
+        assert rule in doc, f"{rule} missing from docs/ANALYSIS.md"
+
+
+def test_repo_self_scan_is_clean():
+    """Tier-1 gate: the performance invariants hold over the whole repo
+    (modulo the committed, reasoned waiver baseline)."""
+    paths = [os.path.join(REPO_ROOT, p)
+             for p in ("fira_tpu", "tests", "scripts")]
+    findings = engine.check_paths(paths)
+    errors = [f.render() for f in findings
+              if f.severity is Severity.ERROR]
+    assert not errors, "\n".join(errors)
+
+
+@pytest.mark.slow
+def test_cli_subprocess_contract():
+    """The documented invocation works end to end with exit 0."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "fira_tpu.analysis.cli", "check",
+         "fira_tpu", "tests", "scripts"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

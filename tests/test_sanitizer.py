@@ -1,0 +1,137 @@
+"""Runtime-sanitizer tests: compile capture, the per-program compile-count
+guard, debug_nans wiring, and the compile-count REGRESSION pin — a few
+fused and unfused tiny-config train steps must trigger ZERO post-warmup
+compilations (the RETRACE invariant at runtime, not just statically).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fira_tpu.analysis import sanitizer
+from fira_tpu.config import fira_tiny
+from fira_tpu.data.batching import make_batch
+from fira_tpu.data.dataset import FiraDataset
+from fira_tpu.data.synthetic import write_corpus_dir
+from fira_tpu.model.model import FiraModel
+from fira_tpu.train import step as step_lib
+from fira_tpu.train.state import init_state
+
+
+def test_compile_capture_counts_compilations():
+    with sanitizer.compile_capture() as watcher:
+        f = jax.jit(lambda x: x * 2.0)
+        f(jnp.ones((3,)))
+        first = watcher.count
+        f(jnp.ones((3,)))          # cached: no new compile
+        same = watcher.count
+        f(jnp.ones((4,)))          # new shape: recompiles
+        grown = watcher.count
+    assert first >= 1
+    assert same == first
+    assert grown > same
+    assert watcher.messages and watcher.messages[0].startswith("Compiling")
+
+
+def test_guard_allows_warmup_then_raises_on_retrace():
+    with sanitizer.compile_capture() as watcher:
+        guard = sanitizer.CompileGuard(watcher)
+        f = jax.jit(lambda x: x + 1.0)
+        f(jnp.ones((2,)))
+        guard.step("f")            # warmup: compilation allowed
+        f(jnp.ones((2,)))
+        guard.step("f")            # steady state: no compile, fine
+        f(jnp.ones((5,)))          # shape drift -> recompile
+        with pytest.raises(sanitizer.RetraceError, match="program 'f'"):
+            guard.step("f")
+
+
+def test_guard_is_per_label():
+    """A second program's warmup compile must not trip the first label —
+    the fused-steps epoch tail legitimately compiles late."""
+    with sanitizer.compile_capture() as watcher:
+        guard = sanitizer.CompileGuard(watcher)
+        f = jax.jit(lambda x: x + 1.0)
+        g = jax.jit(lambda x: x * 3.0)
+        f(jnp.ones((2,)))
+        guard.step("f")
+        g(jnp.ones((2,)))          # late first dispatch of another program
+        guard.step("g")            # its own warmup: no raise
+        f(jnp.ones((2,)))
+        guard.step("f")
+
+
+def test_sanitize_restores_config_and_catches_nans():
+    prev = jax.config.jax_debug_nans
+    with sanitizer.sanitize() as guard:
+        assert guard is not None
+        assert jax.config.jax_debug_nans
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jnp.log(x))(jnp.float32(-1.0))
+    assert jax.config.jax_debug_nans == prev
+
+
+@pytest.fixture(scope="module")
+def tiny(tmp_path_factory):
+    data_dir = str(tmp_path_factory.mktemp("san_corpus"))
+    write_corpus_dir(data_dir, n_commits=24, seed=11)
+    cfg = fira_tiny(batch_size=4)
+    dataset = FiraDataset(data_dir, cfg)
+    return dataset
+
+
+def test_guard_wiring_through_train_loop(tiny, tmp_path):
+    """End-to-end: train() threads the guard through every dispatch site
+    (train_step + dev_step labels) without tripping on a healthy run —
+    pins the label placement, not just CompileGuard mechanics."""
+    from fira_tpu.train.loop import train
+
+    dataset = tiny
+    cfg = dataset.cfg.replace(epochs=1, dev_start_epoch=0,
+                              dev_every_batches=4)
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        result = train(dataset, cfg, out_dir=str(tmp_path / "out"),
+                       epochs=1, resume=False, guard=guard)
+    assert result.epochs_run == 1
+    # both programs dispatched >1 time and the guard saw them
+    assert guard._seen.get("train_step", 0) >= 2
+    assert guard._seen.get("dev_step", 0) >= 1
+    assert guard.compiles_after_warmup() == 0
+
+
+def test_compile_count_regression_unfused_and_fused(tiny):
+    """The one-compile contract over the real train step: N dispatches of
+    each program = exactly its warmup compiles, zero after."""
+    dataset = tiny
+    cfg = dataset.cfg
+    model = FiraModel(cfg)
+    split = dataset.splits["train"]
+    rng = np.random.RandomState(0)
+
+    def fresh_batch():
+        idx = rng.choice(len(split), cfg.batch_size, replace=True)
+        return make_batch(split, idx, cfg)
+
+    state = init_state(model, cfg, fresh_batch())
+    with sanitizer.compile_capture() as watcher:
+        guard = sanitizer.CompileGuard(watcher)
+        step = jax.jit(step_lib.make_train_step(model, cfg))
+        for i in range(3):
+            state, metrics = step(state, fresh_batch())
+            np.asarray(jax.device_get(metrics["loss"]))
+            # step_counting records instead of raising, so the assert below
+            # pins the accounting itself (the raise path has its own test)
+            extra = guard.step_counting("train_step")
+            assert extra == 0, f"unfused step {i} recompiled"
+
+        multi = jax.jit(step_lib.make_multi_step(model, cfg))
+        for i in range(2):
+            stacked = step_lib.stack_batches([fresh_batch(), fresh_batch()])
+            state, metrics = multi(state, stacked)
+            np.asarray(jax.device_get(metrics["loss"]))
+            extra = guard.step_counting("grouped_step")
+            assert extra == 0, f"fused dispatch {i} recompiled"
+        assert guard.compiles_after_warmup() == 0
+        assert watcher.count > 0, "capture saw no compiles at all — inert"

@@ -256,9 +256,11 @@ def test_kv_cached_beam_matches_full_redecode(tiny_setup, tiny_model_state):
     for compat in (True, False):
         cfg = dataclasses.replace(dataset.cfg, beam_compat_prob_space=compat)
         batch = make_batch(test_split, np.arange(min(4, len(test_split))), cfg)
+        # firacheck: allow[RETRACE] each iteration compiles a DIFFERENT cfg variant (prob/log space) for the equivalence check — test-only, off the hot path
         tok_full, p_full = jax.jit(
             lambda p, b: beam_search(model, p, b, cfg)
         )(state.params, batch)
+        # firacheck: allow[RETRACE] same per-variant compile as above, kv-cached side of the equivalence pair
         tok_kv, p_kv = jax.jit(
             lambda p, b: beam_search_cached(model, p, b, cfg)
         )(state.params, batch)
@@ -285,8 +287,10 @@ def test_factored_topk_beam_matches_fused(tiny_setup, tiny_model_state):
             cfg_f = dataclasses.replace(cfg, beam_factored_topk=True)
             batch = make_batch(test_split,
                                np.arange(min(4, len(test_split))), cfg)
+            # firacheck: allow[RETRACE] compiles a distinct (prob-mode, cache-impl) variant per iteration for the factored-topk equivalence matrix — test-only
             tok_a, p_a = jax.jit(
                 lambda p, b: impl(model, p, b, cfg))(state.params, batch)
+            # firacheck: allow[RETRACE] factored-topk side of the same per-variant equivalence pair
             tok_b, p_b = jax.jit(
                 lambda p, b: impl(model, p, b, cfg_f))(state.params, batch)
             np.testing.assert_array_equal(np.asarray(tok_a),
