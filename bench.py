@@ -42,10 +42,17 @@ Two timings are reported:
                                  any real host-link speed (PCIe-gen3-era
                                  12 GB/s -> 0.5 ms/batch). MFU is computed
                                  against this timing.
-  value_e2e / step_time_s        end-to-end ON THIS RIG: numpy host batches
-                                 through the prefetcher
-                                 (data.batching.prefetch_to_device, the same
-                                 pipeline train/loop.py uses), H2D included.
+  value_e2e / step_time_s        end-to-end ON THIS RIG: batches ASSEMBLED
+                                 (make_batch) and transferred through the
+                                 async Feeder (data.feeder, the same
+                                 pipeline train/loop.py uses — assembly +
+                                 H2D on background workers, docs/
+                                 PIPELINE.md), H2D included. feed_stall_frac
+                                 rides along: the share of the e2e window
+                                 the consumer spent blocked on the feed,
+                                 with feed_stall_frac_sync_assembly as the
+                                 synchronous-assembly (num_workers=0)
+                                 control leg measured the same way.
                                  The rig's host link is the bench tunnel,
                                  whose effective bandwidth swings >10x run
                                  to run (22-187 ms/step observed for
@@ -98,6 +105,11 @@ under the driver's observed ~18-min kill window, watchdog runs opt into
 longer budgets explicitly),
 FIRA_BENCH_WORKER_TIMEOUT (s, default 1500), FIRA_BENCH_RETRY_SLEEP (s),
 FIRA_BENCH_PROBE_RETRY_SLEEP (s, default 60 — pause between probe attempts),
+FIRA_BENCH_PROBE_IDENTICAL_LIMIT (default 4 — after this many CONSECUTIVE
+probe failures with an identical signature (same rc, same digit-stripped
+stderr tail; e.g. BENCH_r05's 7 x 90 s identical backend-init timeouts),
+abort early with a structured record instead of burning the rest of the
+budget on a deterministic outage; 0 disables),
 FIRA_BENCH_ALLOW_CPU=1 (let the worker run on CPU — for harness testing
 only; the result is flagged "platform": "cpu"),
 FIRA_BENCH_PRODUCTION_KNOBS (JSON FiraConfig fields applied by default —
@@ -135,6 +147,22 @@ PEAK_BF16_FLOPS = {
     "TPU v6e": 918e12,
     "TPU v7": 2307e12,
 }
+
+
+def _load_torch_anchor() -> dict | None:
+    """TORCH_ANCHOR.json (written by scripts/torch_anchor.py next to
+    BASELINE.json): the MEASURED reference-stack denominator for this host.
+    vs_baseline keeps the estimated 340 c/s/chip for cross-round
+    comparability; when the measured anchor exists it rides along as
+    vs_torch_anchor so perf claims stop resting on an estimate alone."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "TORCH_ANCHOR.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return rec if rec.get("commits_per_sec_per_chip") else None
 
 
 def _peak_flops(device_kind: str, dtype: str) -> float | None:
@@ -333,13 +361,13 @@ def worker() -> None:
     # K>1 = the production device loop (one dispatch runs K steps via
     # lax.scan). The timed feeds rotate two K-stacked groups, so build 2*K
     # distinct base batches — otherwise the groups would alias the same
-    # data.
+    # data. Index sets are kept so the e2e feeder leg re-assembles the
+    # byte-identical batches from scratch on its workers.
     K = max(1, cfg.fused_steps)
     n_base = max(4, 2 * K)
-    host_batches = [
-        make_batch(split, rng.choice(n_data, batch_size, replace=True), cfg)
-        for _ in range(n_base)
-    ]
+    base_indices = [rng.choice(n_data, batch_size, replace=True)
+                    for _ in range(n_base)]
+    host_batches = [make_batch(split, ix, cfg) for ix in base_indices]
 
     import jax.numpy as jnp
 
@@ -391,6 +419,8 @@ def worker() -> None:
 
     state_box = [state]
 
+    from fira_tpu.data.feeder import Feeder
+
     def timed_windows(feed) -> float:
         """Median steady-state seconds per window; `feed(w)` yields the w-th
         window's batch iterator."""
@@ -426,16 +456,69 @@ def worker() -> None:
     dt_compute = timed_windows(
         lambda _w: (dev_batches[i % len(dev_batches)] for i in range(n_calls)))
 
-    # (b) end-to-end: numpy host batches through the double-buffered
-    # prefetcher — the framework's real input pipeline (train/loop.py uses
-    # the same prefetch_to_device); transfers overlap compute.
-    from fira_tpu.data.batching import prefetch_to_device
+    # (b) end-to-end: the framework's real input pipeline (train/loop.py
+    # rides the same Feeder) — each dispatch group is ASSEMBLED from
+    # scratch (make_batch (+ stack)) on the feeder's workers and its
+    # device_put overlaps the previous group's compute. ONE feeder persists
+    # across all windows, exactly like one feeder persists across an epoch:
+    # the throwaway window absorbs the pipeline fill, the steady windows
+    # measure the warm pipeline. feed_stall_frac = share of steady wall
+    # clock the consumer spent blocked on the feed.
+    def assemble_group(g: int):
+        if K > 1:
+            return step_lib.stack_batches([
+                make_batch(split,
+                           base_indices[(g * K + i) % len(base_indices)],
+                           cfg)
+                for i in range(K)])
+        return make_batch(split, base_indices[g % len(base_indices)], cfg)
 
-    def prefetched(_w):
-        return (b for b, _ in prefetch_to_device(
-            (host_groups[i % len(host_groups)] for i in range(n_calls))))
+    def timed_feeder_windows(num_workers: int):
+        """(median steady window seconds, {feed_stall_frac,
+        queue_depth_mean}) with stall/depth accounted over the steady
+        windows only (stats deltas around the throwaway window)."""
+        total_calls = (n_windows + 1) * n_calls
+        tasks = ((lambda i=i: assemble_group(i % 2))
+                 for i in range(total_calls))
+        times = []
+        with Feeder(tasks, num_workers=num_workers,
+                    depth=cfg.feeder_depth) as feeder:
+            stall0 = depth0 = fed0 = 0.0
+            stall_s = depth_sum = fed_n = 0.0
+            for w in range(n_windows + 1):
+                t0 = time.perf_counter()
+                for _ in range(n_calls):
+                    item = next(feeder)
+                    state_box[0], m = train_step(state_box[0], item.device)
+                loss = float(np.asarray(
+                    jax.device_get(m["loss"])).ravel()[-1])
+                times.append(time.perf_counter() - t0)
+                st = feeder.stats()
+                if w == 0:  # snapshot after the fill window
+                    stall0 = st["feed_stall_s"]
+                    depth0 = st["queue_depth_sum"]
+                    fed0 = st["batches"]
+                else:
+                    stall_s = st["feed_stall_s"] - stall0
+                    depth_sum = st["queue_depth_sum"] - depth0
+                    fed_n = st["batches"] - fed0
+                if not math.isfinite(loss):
+                    raise RuntimeError(f"non-finite loss {loss} in window {w}")
+        steady = sorted(times[1:])
+        total_t = sum(times[1:])
+        info = {
+            "feed_stall_frac": round(min(1.0, stall_s / total_t), 4),
+            "queue_depth_mean": (round(depth_sum / fed_n, 2)
+                                 if fed_n else 0.0),
+        }
+        return steady[len(steady) // 2], info
 
-    dt_e2e = timed_windows(prefetched)
+    dt_e2e, e2e_info = timed_feeder_windows(cfg.feeder_workers)
+
+    # (c) control leg: synchronous assembly on the consumer thread
+    # (num_workers=0, the pre-feeder world) — the stall fraction the async
+    # feeder must beat, measured by the same accounting.
+    dt_sync, sync_info = timed_feeder_windows(0)
 
     # the step above is jitted without a mesh: it runs on exactly one chip
     # regardless of how many are visible
@@ -454,6 +537,7 @@ def worker() -> None:
     # transfer cost, which on the tunneled bench rig is weather, not model.
     mfu = round(flops / compute_step_time / peak, 4) if peak else None
 
+    anchor = _load_torch_anchor()
     _emit_worker({
         "metric": METRIC,
         "value": round(value, 2),
@@ -469,12 +553,29 @@ def worker() -> None:
         "step_time_s": round(step_time, 5),
         "compute_step_time_s": round(compute_step_time, 5),
         "value_e2e_host_link": round(value_e2e, 2),
+        # input-pipeline observability (docs/PIPELINE.md): stall fraction
+        # with the async feeder vs the synchronous-assembly control leg,
+        # measured by the same per-item accounting
+        "feed_stall_frac": e2e_info["feed_stall_frac"],
+        "feeder_queue_depth_mean": e2e_info["queue_depth_mean"],
+        "feeder_workers": cfg.feeder_workers,
+        "feed_stall_frac_sync_assembly": sync_info["feed_stall_frac"],
+        "value_e2e_sync_assembly": round(
+            batch_size / (dt_sync / steps_per_window) / n_chips, 2),
         "peak_flops": peak,
         "platform": platform,
         "device_kind": device_kind,
         "dtype": dtype,
         "batch_size": batch_size,
         "fused_steps": K,
+        **({"vs_torch_anchor": round(
+                value / anchor["commits_per_sec_per_chip"], 3),
+            "torch_anchor": {
+                "commits_per_sec_per_chip":
+                    anchor["commits_per_sec_per_chip"],
+                "device": anchor.get("device"),
+                "batch_size": anchor.get("batch_size"),
+            }} if anchor else {}),
         **({"production_knobs": production_knobs} if production_knobs else {}),
         **({"overrides": overrides} if overrides else {}),
     })
@@ -593,6 +694,17 @@ def orchestrate() -> None:
     probed = None
     n_probes = 0
     fast_fails = 0  # consecutive quick nonzero exits (not tunnel hangs)
+    # Identical-failure backoff (BENCH_r05: 7 x 90 s byte-identical
+    # backend-init timeouts burned the whole 900 s budget): after
+    # FIRA_BENCH_PROBE_IDENTICAL_LIMIT consecutive probe failures with the
+    # same signature (rc + digit-stripped stderr tail, so timestamps don't
+    # defeat the comparison), abort early with the structured record — a
+    # failure mode that repeats verbatim is an outage, not flakiness.
+    import re
+
+    ident_limit = int(os.environ.get("FIRA_BENCH_PROBE_IDENTICAL_LIMIT", "4"))
+    last_sig = None
+    n_identical = 0
     while True:
         n_probes += 1
         t0 = time.time()
@@ -620,6 +732,17 @@ def orchestrate() -> None:
         if fast_fails >= 5:
             fail(f"probe failed fast (rc={rc}) {fast_fails} times in a row — "
                  "deterministic failure, not a tunnel outage")
+        sig = (rc, re.sub(r"\d+", "#", rec["tail"]))
+        n_identical = n_identical + 1 if sig == last_sig else 1
+        last_sig = sig
+        if ident_limit and n_identical >= ident_limit:
+            fail(f"aborting early: {n_identical} consecutive identical probe "
+                 f"failures ({'timeout' if rc is None else f'rc={rc}'} at "
+                 f"{probe_secs:.0f}s each) with "
+                 f"{max(0.0, deadline - time.time()):.0f}s of probe budget "
+                 f"left — a verbatim-repeating failure is an outage, not "
+                 f"flakiness (FIRA_BENCH_PROBE_IDENTICAL_LIMIT="
+                 f"{ident_limit})")
         if time.time() + 5.0 >= deadline:
             fail(f"backend init failed/hung on all {n_probes} probe attempts "
                  f"over {probe_budget:.0f}s budget "

@@ -17,7 +17,7 @@ import argparse
 import sys
 from typing import List, Optional
 
-from fira_tpu.analysis import engine
+from fira_tpu.analysis import astutil, engine
 from fira_tpu.analysis.findings import RULES, Severity
 
 
@@ -52,9 +52,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     # must not turn into a silently-green scan over nothing
     files = []
     empty = []
+    seen = set()
     for p in args.paths:
         got = engine.iter_py_files([p])
-        (files.extend(got) if got else empty.append(p))
+        if not got:
+            empty.append(p)
+        for f in got:
+            # dedupe: a file named explicitly AND reached via a directory
+            # argument (e.g. check.sh pinning data/feeder.py alongside the
+            # fira_tpu tree) must not double-report findings
+            key = astutil.normalize_path(f)
+            if key not in seen:
+                seen.add(key)
+                files.append(f)
     if empty:
         print(f"firacheck: no Python files under {', '.join(empty)} — "
               f"refusing to report a clean scan over nothing",

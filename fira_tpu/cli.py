@@ -263,7 +263,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         print(f"best dev bleu: {result.best_bleu:.4f}  "
               f"throughput: {result.commits_per_sec_per_chip:.1f} "
-              f"commits/sec/chip")
+              f"commits/sec/chip  "
+              f"feed_stall_frac: {result.feed_stall_frac:.3f}")
         return 0
 
     # test: load best params, beam-decode, write OUTPUT file

@@ -180,6 +180,19 @@ class FiraConfig:
     # train batch loop). Epoch-tail batches (< K) run per-step.
     fused_steps: int = 1
 
+    # --- host input pipeline (data/feeder.py; docs/PIPELINE.md) ---
+    # Background threads assembling batches (make_batch + sharded
+    # device_put) ahead of the train/dev/decode loops. 0 = synchronous
+    # assembly on the consumer thread (debug fallback + the control leg
+    # feed_stall_frac is measured against). Batch ORDER is identical for
+    # any worker count — the deterministic (seed, epoch) sequence is
+    # computed up front and reassembled in order (pinned by tests).
+    feeder_workers: int = 2
+    # Max batches in flight (dispatched, not yet consumed): bounds host
+    # memory at O(depth * batch_bytes) while keeping assembly + H2D ahead
+    # of the step dispatch.
+    feeder_depth: int = 4
+
     # --- long context ---
     # >1 routes decoder cross-attention through ring attention
     # (parallel/ring.py) over a (data, seq) mesh with that many sequence

@@ -13,7 +13,7 @@ SURVEY.md §7 hard-part 3.
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -40,9 +40,117 @@ def sort_edge_rows(senders, receivers, values, kinds, graph_len: int):
     return senders, receivers, values, kinds
 
 
+def _gather_edges_loop(split: ProcessedSplit, indices: np.ndarray,
+                       cfg: FiraConfig, bs: int):
+    """Pre-refactor per-row edge gather — the GOLDEN REFERENCE the
+    vectorized path is pinned bit-exact against (tests/
+    test_batching_golden.py). Not called on any hot path."""
+    senders = np.zeros((bs, cfg.max_edges), dtype=np.int16)
+    receivers = np.zeros((bs, cfg.max_edges), dtype=np.int16)
+    values = np.zeros((bs, cfg.max_edges), dtype=np.float32)
+    # pad entries keep kind 0 — harmless, a pad edge's value is 0 so any
+    # gain multiplies into nothing
+    kinds = (np.zeros((bs, cfg.max_edges), dtype=np.int8)
+             if cfg.typed_edges else None)
+    offsets = split.arrays["edge_offsets"]
+    for row, i in enumerate(indices):
+        lo, hi = offsets[i], offsets[i + 1]
+        n = hi - lo
+        if n > cfg.max_edges:
+            raise ValueError(f"sample {i}: {n} edges > max_edges={cfg.max_edges}")
+        senders[row, :n] = split.arrays["edge_senders"][lo:hi]
+        receivers[row, :n] = split.arrays["edge_receivers"][lo:hi]
+        values[row, :n] = split.arrays["edge_values"][lo:hi]
+        if kinds is not None:
+            kinds[row, :n] = split.arrays["edge_kinds"][lo:hi]
+    return senders, receivers, values, kinds
+
+
+# Mean-edges-per-row crossover between the two vectorized-gather regimes,
+# measured by scripts/batch_assembly_bench.py on this host: a numpy fancy
+# gather/scatter costs a few ns/ELEMENT plus ~10 bytes/element of
+# temporary traffic, while a per-row contiguous slice copy costs a few
+# us/ROW of interpreter overhead plus a near-free memcpy. Below the
+# crossover (many rows, few edges — sparse-graph corpora, stacked-group
+# assembly) the flat cumsum/np.repeat gather wins ~3-5x; above it (the
+# flagship 650-node graphs at ~700+ edges/sample) per-row memcpy beats
+# per-element fancy indexing and the temporaries' memory traffic, so the
+# addressing stays vectorized but the copies stay slices. Conservative on
+# purpose: a host with faster fancy indexing only leaves a little on the
+# table, never regresses.
+_VEC_EDGE_CROSSOVER = 64
+
+
+def _gather_edges_vectorized(split: ProcessedSplit, indices: np.ndarray,
+                             cfg: FiraConfig, bs: int):
+    """Vectorized COO gather, bit-exact vs ``_gather_edges_loop``
+    (identical destination arrays, identical source element order,
+    identical dtype narrowing on assignment; pinned by the golden test).
+
+    Addressing (offsets, counts, the overflow check) is always vectorized.
+    The copies pick a regime by mean edges per row (see
+    ``_VEC_EDGE_CROSSOVER``): the flat cumsum/np.repeat gather — one
+    address computation and four fancy-indexed copies replacing ~bs
+    interpreter iterations — below it, per-row contiguous slice copies
+    above it."""
+    idx = np.asarray(indices, dtype=np.intp)
+    offsets = split.arrays["edge_offsets"]
+    lo = offsets[idx]
+    counts = (offsets[idx + 1] - lo).astype(np.intp)
+    if counts.size and counts.max() > cfg.max_edges:
+        row = int(np.argmax(counts > cfg.max_edges))  # first offender, like the loop
+        raise ValueError(
+            f"sample {idx[row]}: {counts[row]} edges > max_edges={cfg.max_edges}")
+
+    senders = np.zeros((bs, cfg.max_edges), dtype=np.int16)
+    receivers = np.zeros((bs, cfg.max_edges), dtype=np.int16)
+    values = np.zeros((bs, cfg.max_edges), dtype=np.float32)
+    kinds = (np.zeros((bs, cfg.max_edges), dtype=np.int8)
+             if cfg.typed_edges else None)
+    if not counts.size:
+        return senders, receivers, values, kinds
+
+    arrays = split.arrays
+    if counts.mean() > _VEC_EDGE_CROSSOVER:
+        hi = lo + counts
+        for row in range(len(idx)):  # copies only; addressing is above
+            a, b = lo[row], hi[row]
+            n = b - a
+            senders[row, :n] = arrays["edge_senders"][a:b]
+            receivers[row, :n] = arrays["edge_receivers"][a:b]
+            values[row, :n] = arrays["edge_values"][a:b]
+            if kinds is not None:
+                kinds[row, :n] = arrays["edge_kinds"][a:b]
+        return senders, receivers, values, kinds
+
+    # flat regime: every real edge's flat source slot and flat destination
+    # slot — col counts 0..n_row-1 within each row, src = lo + col,
+    # dst = row*max_edges + col (strictly ascending, the cache-friendly
+    # scatter order). 1-D raveled indexing with pre-cast right-hand sides:
+    # 2-D advanced indexing and in-assignment dtype casts both fall off
+    # numpy's fast path (each measured ~4x slower here).
+    total = int(counts.sum())
+    rows = np.repeat(np.arange(len(idx), dtype=np.intp), counts)
+    cols = np.arange(total, dtype=np.intp) - np.repeat(
+        np.cumsum(counts) - counts, counts)
+    src = np.repeat(lo, counts) + cols
+    dst = rows * cfg.max_edges + cols
+    senders.ravel()[dst] = arrays["edge_senders"][src].astype(np.int16)
+    receivers.ravel()[dst] = arrays["edge_receivers"][src].astype(np.int16)
+    values.ravel()[dst] = arrays["edge_values"][src]
+    if kinds is not None:
+        kinds.ravel()[dst] = arrays["edge_kinds"][src].astype(np.int8)
+    return senders, receivers, values, kinds
+
+
 def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
-               batch_size: Optional[int] = None) -> Batch:
-    """Gather + pad a batch. ``indices`` may be shorter than batch_size."""
+               batch_size: Optional[int] = None, *,
+               edge_gather: str = "vectorized") -> Batch:
+    """Gather + pad a batch. ``indices`` may be shorter than batch_size.
+
+    ``edge_gather``: "vectorized" (default, the flat cumsum/np.repeat COO
+    gather) or "loop" (the pre-refactor per-row reference — kept only so
+    the golden test can pin bit-exactness through the full batch path)."""
     bs = batch_size or len(indices)
     n_real = len(indices)
     if n_real > bs:
@@ -92,24 +200,9 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
         raise ValueError(
             f"graph_len={cfg.graph_len} exceeds int16 edge-index range "
             f"(max index {np.iinfo(np.int16).max}); widen the edge dtype")
-    senders = np.zeros((bs, cfg.max_edges), dtype=np.int16)
-    receivers = np.zeros((bs, cfg.max_edges), dtype=np.int16)
-    values = np.zeros((bs, cfg.max_edges), dtype=np.float32)
-    # pad entries keep kind 0 — harmless, a pad edge's value is 0 so any
-    # gain multiplies into nothing
-    kinds = (np.zeros((bs, cfg.max_edges), dtype=np.int8)
-             if cfg.typed_edges else None)
-    offsets = split.arrays["edge_offsets"]
-    for row, i in enumerate(indices):
-        lo, hi = offsets[i], offsets[i + 1]
-        n = hi - lo
-        if n > cfg.max_edges:
-            raise ValueError(f"sample {i}: {n} edges > max_edges={cfg.max_edges}")
-        senders[row, :n] = split.arrays["edge_senders"][lo:hi]
-        receivers[row, :n] = split.arrays["edge_receivers"][lo:hi]
-        values[row, :n] = split.arrays["edge_values"][lo:hi]
-        if kinds is not None:
-            kinds[row, :n] = split.arrays["edge_kinds"][lo:hi]
+    gather = {"vectorized": _gather_edges_vectorized,
+              "loop": _gather_edges_loop}[edge_gather]
+    senders, receivers, values, kinds = gather(split, indices, cfg, bs)
     if cfg.sort_edges:
         senders, receivers, values, kinds = sort_edge_rows(
             senders, receivers, values, kinds, cfg.graph_len)
@@ -143,24 +236,41 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
     return batch
 
 
+def epoch_index_chunks(n: int, cfg: FiraConfig, *,
+                       batch_size: Optional[int] = None,
+                       shuffle: bool = False,
+                       seed: int = 0,
+                       epoch: int = 0,
+                       drop_remainder: bool = False) -> List[np.ndarray]:
+    """The deterministic batch ORDER of an epoch, as a list of index chunks
+    (shuffled like the reference's DataLoader(shuffle=True),
+    run_model.py:387; seed and epoch fold together so each epoch draws a
+    fresh but fully reproducible permutation). This is the single source of
+    truth for batch order: ``epoch_batches`` assembles these chunks inline,
+    the async Feeder (data/feeder.py) assembles the SAME chunks on worker
+    threads — byte-identical sequences either way."""
+    bs = batch_size or cfg.batch_size
+    order = np.arange(n)
+    if shuffle:
+        np.random.RandomState((seed * 1_000_003 + epoch) % (2**31)).shuffle(order)
+    chunks = [order[start : start + bs] for start in range(0, n, bs)]
+    if drop_remainder and chunks and len(chunks[-1]) < bs:
+        chunks.pop()
+    return chunks
+
+
 def epoch_batches(split: ProcessedSplit, cfg: FiraConfig, *,
                   batch_size: Optional[int] = None,
                   shuffle: bool = False,
                   seed: int = 0,
                   epoch: int = 0,
                   drop_remainder: bool = False) -> Iterator[Batch]:
-    """One epoch of fixed-shape batches (shuffled like the reference's
-    DataLoader(shuffle=True), run_model.py:387). Pass the epoch number so
-    each epoch draws a fresh permutation (seed and epoch are folded together);
-    a fixed (seed, epoch) pair is fully deterministic."""
+    """One epoch of fixed-shape batches, assembled inline on the calling
+    thread (see ``epoch_index_chunks`` for the order contract)."""
     bs = batch_size or cfg.batch_size
-    order = np.arange(len(split))
-    if shuffle:
-        np.random.RandomState((seed * 1_000_003 + epoch) % (2**31)).shuffle(order)
-    for start in range(0, len(order), bs):
-        chunk = order[start : start + bs]
-        if drop_remainder and len(chunk) < bs:
-            return
+    for chunk in epoch_index_chunks(len(split), cfg, batch_size=bs,
+                                    shuffle=shuffle, seed=seed, epoch=epoch,
+                                    drop_remainder=drop_remainder):
         yield make_batch(split, chunk, cfg, batch_size=bs)
 
 
@@ -170,18 +280,15 @@ def num_batches(n: int, batch_size: int, drop_remainder: bool = False) -> int:
 
 def prefetch_to_device(batches: Iterator[Batch], *, size: int = 2,
                        sharding=None) -> Iterator[tuple]:
-    """Double-buffered host->device input pipeline.
+    """Double-buffered host->device pipeline over an ALREADY-ASSEMBLED
+    batch stream — a compatibility shim over data/feeder.Feeder, which
+    subsumed it (the feeder additionally moves batch ASSEMBLY off the
+    consumer thread; train/dev/decode/bench all use it directly now, see
+    docs/PIPELINE.md).
 
-    Keeps ``size`` batches in flight so the transfer of batch i+1 overlaps
-    the compute of batch i (jax.device_put is asynchronous). Feeding numpy
-    straight into a jitted step instead serializes each step's transfer
-    (~8 ms/batch measured through the bench rig's host link at the flagship
-    geometry, scripts/tpu_breakdown.py) with its compute (~107 ms); the
-    slower the host link or the faster the step, the bigger the win. The
-    reference's torch DataLoader has no device prefetch at all: it ships
-    dense 650^2 adjacencies and blocks on .cuda() per batch
-    (run_model.py:94-101).
-
+    Keeps up to ``size`` batches in flight so the transfer of batch i+1
+    overlaps the compute of batch i (jax.device_put is asynchronous); the
+    source iterator itself is drained on the feeder's dispatcher thread.
     Yields ``(device_batch, n_valid)``; n_valid (the count of real rows,
     for throughput bookkeeping) is computed host-side BEFORE the transfer —
     reading it back from the device array would force a mid-epoch sync.
@@ -191,26 +298,9 @@ def prefetch_to_device(batches: Iterator[Batch], *, size: int = 2,
     callable ``batch -> sharding-pytree-or-None`` handles streams that mix
     shapes (e.g. fused K-stacked groups followed by per-step tail batches).
     """
-    import collections
+    from fira_tpu.data.feeder import Feeder
 
-    import jax
-
-    def put(b: Batch):
-        n_valid = int(b["valid"].sum())
-        sh = sharding(b) if callable(sharding) else sharding
-        dev = jax.device_put(b, sh) if sh is not None else jax.device_put(b)
-        return dev, n_valid
-
-    buf = collections.deque()
-    it = iter(batches)
-    try:
-        while len(buf) < max(1, size):
-            buf.append(put(next(it)))
-    except StopIteration:
-        pass
-    while buf:
-        yield buf.popleft()
-        try:
-            buf.append(put(next(it)))
-        except StopIteration:
-            pass
+    with Feeder.from_batches(batches, depth=max(1, size),
+                             sharding=sharding) as feeder:
+        for item in feeder:
+            yield item.device, item.n_valid

@@ -1,0 +1,315 @@
+"""Asynchronous host input pipeline: batch assembly + H2D off the hot loop.
+
+The COO rewrite fixed the wire format (data/batching.py) and
+``prefetch_to_device`` overlapped the H2D transfer, but batch ASSEMBLY
+(gather + pad + narrow + sort) still ran serially on the consumer thread,
+inside the step-dispatch interval. As the device step gets faster (fused
+K-step scans, bf16 wire) that host work becomes the throughput ceiling —
+the reference has the same disease terminally (torch DataLoader densifies
+650^2 adjacencies per sample and blocks on .cuda() per batch,
+run_model.py:94-101).
+
+``Feeder`` is a bounded worker pool that runs assembly tasks ahead of the
+training loop:
+
+- **order**: the task sequence IS the batch order. Workers assemble out of
+  order; the consumer side emits strictly in sequence, so the exact
+  deterministic ``(seed, epoch)`` stream of ``data.batching.epoch_batches``
+  is preserved byte-for-byte (pinned by tests/test_feeder.py).
+- **bounding**: at most ``depth`` tasks are in flight (dispatched but not
+  yet consumed) — host memory stays O(depth * batch_bytes).
+- **transfer**: each worker finishes its task with a (sharded)
+  ``jax.device_put``, which is asynchronous — the transfer of batch i+1
+  overlaps the compute of batch i, same as the old prefetcher.
+- **errors**: the first worker/dispatcher exception is re-raised at the
+  consumer on its next ``__next__`` (not deferred until the failing
+  sequence number comes up), so a poisoned pipeline surfaces within one
+  step.
+- **shutdown**: ``close()`` (or the context manager / end-of-stream /
+  error paths, which call it) stops dispatch, unblocks and joins every
+  thread — no live threads remain (pinned by tests/test_feeder.py).
+- **observability**: every item carries ``stall_s`` (how long the consumer
+  blocked waiting for it — the feed-stall numerator train/loop.py feeds
+  into profiling.Meter) and ``queue_depth`` (ready-but-unconsumed batches
+  when the consumer arrived — persistently 0 means the feed can't keep
+  up); ``stats()`` aggregates them.
+
+``num_workers=0`` is the synchronous mode: same interface, tasks run
+inline on the consumer thread (assembly time then IS stall), no threads
+created. It is both the debug fallback and the control leg bench.py
+measures ``feed_stall_frac`` against.
+
+Sync boundaries: the feeder itself never syncs with the device — workers
+only *enqueue* transfers; ``n_valid`` is computed host-side from the numpy
+batch BEFORE the transfer (reading it back would force a mid-epoch sync).
+See docs/PIPELINE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
+
+Batch = Dict[str, Any]
+Task = Callable[[], Batch]
+
+
+@dataclasses.dataclass
+class FedBatch:
+    """One emitted pipeline item."""
+
+    index: int          # position in the deterministic batch order
+    host: Batch         # the assembled numpy batch (for host-side fields)
+    device: Any         # jax.device_put result (== host when put=False)
+    n_valid: int        # real (non-pad) rows, computed pre-transfer
+    stall_s: float      # consumer time blocked waiting for THIS item
+    queue_depth: int    # ready-but-unconsumed items when consumer arrived
+
+
+class Feeder:
+    """Bounded-queue background batch assembly + H2D pipeline.
+
+    ``tasks``: iterable of zero-arg callables, each returning one host
+    batch; the iterable is drained lazily on the dispatcher thread, so a
+    generator is fine. ``sharding``: pytree of NamedShardings or a callable
+    ``batch -> sharding-or-None`` (mixed-shape streams). ``put=False``
+    skips the device transfer (host-only pipelines, e.g. tests).
+    """
+
+    def __init__(self, tasks: Iterable[Task], *, num_workers: int = 2,
+                 depth: int = 4, sharding=None, put: bool = True):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        if num_workers < 0:
+            raise ValueError(f"num_workers must be >= 0, got {num_workers}")
+        self._sharding = sharding
+        self._put = put
+        self._num_workers = num_workers
+        self._depth = depth
+        self._next = 0                 # next sequence number to emit
+        self._n_stalls = 0
+        self._stall_s = 0.0
+        self._stall_max = 0.0
+        self._depth_sum = 0
+        self._depth_min: Optional[int] = None
+        self._closed = False
+
+        if num_workers == 0:
+            self._task_iter: Iterator[Task] = iter(tasks)
+            self._threads: list = []
+            return
+
+        self._cond = threading.Condition()
+        self._ready: Dict[int, FedBatch] = {}
+        self._error: Optional[BaseException] = None
+        self._total: Optional[int] = None   # set when tasks exhaust
+        self._stop = threading.Event()
+        self._inflight = threading.Semaphore(depth)
+        self._task_q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._threads = [
+            threading.Thread(target=self._dispatch, args=(iter(tasks),),
+                             name="fira-feeder-dispatch", daemon=True)
+        ] + [
+            threading.Thread(target=self._work, name=f"fira-feeder-worker-{i}",
+                             daemon=True)
+            for i in range(num_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # --- pipeline threads ---
+
+    def _dispatch(self, tasks: Iterator[Task]) -> None:
+        seq = 0
+        try:
+            for task in tasks:
+                # bound in-flight work; poll so close() can interrupt a
+                # dispatcher blocked on a full pipeline
+                while not self._stop.is_set():
+                    if self._inflight.acquire(timeout=0.05):
+                        break
+                if self._stop.is_set():
+                    return
+                self._task_q.put((seq, task))
+                seq += 1
+        except BaseException as e:  # a raising tasks generator poisons the feed
+            self._poison(e)
+            return
+        finally:
+            for _ in range(self._num_workers):
+                self._task_q.put(None)
+        with self._cond:
+            self._total = seq
+            self._cond.notify_all()
+
+    def _work(self) -> None:
+        while True:
+            got = self._task_q.get()
+            if got is None or self._stop.is_set():
+                return
+            seq, task = got
+            try:
+                host = task()
+                # host-side row count BEFORE the transfer — reading it back
+                # from the device array would force a mid-epoch sync
+                n_valid = int(host["valid"].sum())
+                device = self._device_put(host)
+            except BaseException as e:
+                self._poison(e)
+                return
+            with self._cond:
+                self._ready[seq] = FedBatch(seq, host, device, n_valid,
+                                            0.0, 0)
+                self._cond.notify_all()
+
+    def _device_put(self, host: Batch):
+        if not self._put:
+            return host
+        import jax
+
+        sh = self._sharding(host) if callable(self._sharding) else self._sharding
+        return jax.device_put(host, sh) if sh is not None else jax.device_put(host)
+
+    def _poison(self, e: BaseException) -> None:
+        with self._cond:
+            if self._error is None:
+                self._error = e
+            self._cond.notify_all()
+        self._stop.set()
+
+    # --- consumer side ---
+
+    def __iter__(self) -> "Feeder":
+        return self
+
+    def __next__(self) -> FedBatch:
+        if self._num_workers == 0:
+            return self._next_sync()
+        t0 = time.perf_counter()
+        with self._cond:
+            depth_seen = len(self._ready)
+            while True:
+                if self._error is not None:
+                    err = self._error
+                    break
+                if self._next in self._ready:
+                    err = None
+                    item = self._ready.pop(self._next)
+                    break
+                if self._total is not None and self._next >= self._total:
+                    err = StopIteration()
+                    break
+                self._cond.wait()
+        if err is not None:
+            self.close()
+            raise err
+        stall = time.perf_counter() - t0
+        self._next += 1
+        self._inflight.release()
+        item.stall_s = stall
+        item.queue_depth = depth_seen
+        self._record(stall, depth_seen)
+        return item
+
+    def _next_sync(self) -> FedBatch:
+        t0 = time.perf_counter()
+        try:
+            task = next(self._task_iter)
+            host = task()
+            n_valid = int(host["valid"].sum())
+            device = self._device_put(host)
+        except StopIteration:
+            self._closed = True
+            raise
+        stall = time.perf_counter() - t0
+        seq = self._next
+        self._next += 1
+        self._record(stall, 0)
+        return FedBatch(seq, host, device, n_valid, stall, 0)
+
+    def _record(self, stall: float, depth_seen: int) -> None:
+        self._n_stalls += 1
+        self._stall_s += stall
+        self._stall_max = max(self._stall_max, stall)
+        self._depth_sum += depth_seen
+        self._depth_min = (depth_seen if self._depth_min is None
+                           else min(self._depth_min, depth_seen))
+
+    # --- lifecycle ---
+
+    def close(self) -> None:
+        """Stop dispatch, unblock and join every pipeline thread. Idempotent;
+        called automatically at end-of-stream, on error, and by the context
+        manager — callers that break out of iteration early must call it (or
+        use ``with``)."""
+        if self._closed:
+            return
+        self._closed = True
+        if not self._threads:
+            return
+        self._stop.set()
+        for _ in range(self._num_workers):
+            self._task_q.put(None)   # unblock workers parked on get()
+        with self._cond:
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join()
+        self._threads = []
+
+    def __enter__(self) -> "Feeder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort: never leave threads parked forever
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # --- observability ---
+
+    def stats(self) -> Dict[str, float]:
+        """Aggregate feed-stall / queue-depth stats over the items emitted
+        so far. ``feed_stall_s`` is the numerator of ``feed_stall_frac``
+        (profiling.Meter owns the interval-time denominator)."""
+        n = self._n_stalls
+        return {
+            "batches": float(n),
+            "feed_stall_s": self._stall_s,
+            "feed_stall_max_ms": 1e3 * self._stall_max,
+            "queue_depth_sum": float(self._depth_sum),
+            "queue_depth_mean": (self._depth_sum / n) if n else 0.0,
+            "queue_depth_min": float(self._depth_min or 0),
+            "num_workers": float(self._num_workers),
+            "depth": float(self._depth),
+        }
+
+    # --- adapters ---
+
+    @classmethod
+    def from_batches(cls, batches: Iterable[Batch], *, depth: int = 2,
+                     num_workers: int = 1, sharding=None,
+                     put: bool = True) -> "Feeder":
+        """Wrap an ALREADY-ASSEMBLED batch stream (generator or list): the
+        stream is drained on the dispatcher thread and each batch's
+        device_put runs on a worker — the contract of the old
+        ``prefetch_to_device``, which is now a shim over this."""
+        tasks = ((lambda b=b: b) for b in batches)
+        return cls(tasks, num_workers=num_workers, depth=depth,
+                   sharding=sharding, put=put)
+
+
+def assembly_tasks(split, chunks, cfg, *, batch_size: Optional[int] = None
+                   ) -> Iterator[Task]:
+    """One ``make_batch`` task per index chunk (see
+    data.batching.epoch_index_chunks for the order contract)."""
+    from fira_tpu.data.batching import make_batch
+
+    for chunk in chunks:
+        yield (lambda c=chunk: make_batch(split, c, cfg,
+                                          batch_size=batch_size))

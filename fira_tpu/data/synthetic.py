@@ -258,6 +258,28 @@ def make_memory_split(cfg, n: int, seed: int = 0, pad_vocab_to: int = 0,
     return cfg, ProcessedSplit.from_examples(examples), word_vocab
 
 
+def thin_edges(split, k: int):
+    """Copy of a ProcessedSplit with each sample's edges truncated to at
+    most k — drops the mean edge count below the batching gather's
+    flat-regime crossover (data/batching._VEC_EDGE_CROSSOVER) so the
+    golden test and the assembly microbench can exercise both copy
+    regimes on one corpus."""
+    import numpy as np
+
+    from fira_tpu.data.dataset import ProcessedSplit
+
+    arr = split.arrays
+    off = arr["edge_offsets"]
+    counts = np.minimum(np.diff(off), k)
+    new = dict(arr)
+    new["edge_offsets"] = np.concatenate(
+        [[0], np.cumsum(counts)]).astype(off.dtype)
+    for f in ("edge_senders", "edge_receivers", "edge_values", "edge_kinds"):
+        new[f] = np.concatenate(
+            [arr[f][off[i] : off[i] + counts[i]] for i in range(len(counts))])
+    return ProcessedSplit(new)
+
+
 def make_memory_batch(cfg, n: int, seed: int = 0, pad_vocab_to: int = 0):
     """One in-memory batch of n fresh synthetic commits (no disk)."""
     from fira_tpu.data.batching import make_batch

@@ -14,8 +14,9 @@ import jax
 import numpy as np
 
 from fira_tpu.config import FiraConfig
-from fira_tpu.data.batching import epoch_batches
+from fira_tpu.data.batching import epoch_index_chunks
 from fira_tpu.data.dataset import FiraDataset
+from fira_tpu.data.feeder import Feeder, assembly_tasks
 from fira_tpu.decode.beam import make_beam_search
 from fira_tpu.decode.text import cook_prediction, deanonymize, reference_words
 from fira_tpu.eval.dev_bleu import nltk_sentence_bleu
@@ -54,9 +55,17 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
     total_bleu, n = 0.0, 0
     cursor = 0
     n_total = len(data)
-    with open(partial_path, "w") as out_f:
-        for batch in epoch_batches(data, cfg, batch_size=cfg.test_batch_size):
-            tokens, probs = beam(params, batch)
+    chunks = epoch_index_chunks(len(data), cfg, batch_size=cfg.test_batch_size)
+    # the Feeder is constructed INSIDE the with (after open succeeds): a
+    # failing open must not leak already-started worker threads
+    with open(partial_path, "w") as out_f, \
+            Feeder(assembly_tasks(data, chunks, cfg,
+                                  batch_size=cfg.test_batch_size),
+                   num_workers=cfg.feeder_workers,
+                   depth=cfg.feeder_depth) as feed:
+        for item in feed:
+            batch = item.host  # numpy fields for host-side text cooking
+            tokens, probs = beam(params, item.device)
             # firacheck: allow[HOST-SYNC] per-batch output collection IS the decode boundary: beams must reach the host to be cooked into text
             tokens = np.asarray(jax.device_get(tokens))
             probs = np.asarray(jax.device_get(probs))  # firacheck: allow[HOST-SYNC] same decode output boundary as the line above

@@ -28,8 +28,9 @@ import jax
 import numpy as np
 
 from fira_tpu.config import FiraConfig
-from fira_tpu.data.batching import epoch_batches, make_batch, prefetch_to_device
+from fira_tpu.data.batching import epoch_index_chunks, make_batch
 from fira_tpu.data.dataset import FiraDataset
+from fira_tpu.data.feeder import Feeder, assembly_tasks
 from fira_tpu.decode.text import cook_prediction, deanonymize, reference_words
 from fira_tpu.eval.dev_bleu import nltk_sentence_bleu
 from fira_tpu.model.model import FiraModel
@@ -73,26 +74,32 @@ def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
     total_bleu = 0.0
     out_lines = []
     cursor = 0
-    for batch in epoch_batches(data, cfg, batch_size=cfg.test_batch_size):
-        # firacheck: allow[HOST-SYNC] dev gate IS a designated sync boundary: teacher-forced ids must reach the host for BLEU scoring (README Design notes)
-        ids = np.asarray(jax.device_get(dev_step(params, batch)))
-        valid = batch["valid"]  # host-side numpy batch field, no device trip
-        if guard is not None:
-            guard.step("dev_step")
-        for i in range(ids.shape[0]):
-            if not valid[i]:
-                continue
-            hyp = cook_prediction(
-                ids[i].tolist(), batch["diff"][i], batch["sub_token"][i],
-                vocab, cfg,
-            )
-            ref = reference_words(batch["msg"][i], vocab)
-            b = nltk_sentence_bleu([ref], hyp)
-            total_bleu += b
-            var_map = (var_maps[indices[cursor]]
-                       if var_maps is not None else None)
-            out_lines.append(" ".join(deanonymize(hyp, var_map)) + f",{b}")
-            cursor += 1
+    chunks = epoch_index_chunks(len(data), cfg, batch_size=cfg.test_batch_size)
+    with Feeder(assembly_tasks(data, chunks, cfg,
+                               batch_size=cfg.test_batch_size),
+                num_workers=cfg.feeder_workers,
+                depth=cfg.feeder_depth) as feed:
+        for item in feed:
+            batch = item.host  # numpy fields for host-side text cooking
+            # firacheck: allow[HOST-SYNC] dev gate IS a designated sync boundary: teacher-forced ids must reach the host for BLEU scoring (README Design notes)
+            ids = np.asarray(jax.device_get(dev_step(params, item.device)))
+            valid = batch["valid"]  # host-side numpy batch field, no device trip
+            if guard is not None:
+                guard.step("dev_step")
+            for i in range(ids.shape[0]):
+                if not valid[i]:
+                    continue
+                hyp = cook_prediction(
+                    ids[i].tolist(), batch["diff"][i], batch["sub_token"][i],
+                    vocab, cfg,
+                )
+                ref = reference_words(batch["msg"][i], vocab)
+                b = nltk_sentence_bleu([ref], hyp)
+                total_bleu += b
+                var_map = (var_maps[indices[cursor]]
+                           if var_maps is not None else None)
+                out_lines.append(" ".join(deanonymize(hyp, var_map)) + f",{b}")
+                cursor += 1
     return total_bleu / max(len(data), 1), "\n".join(out_lines) + "\n"
 
 
@@ -111,6 +118,13 @@ class TrainResult:
     best_bleu: float
     epochs_run: int
     commits_per_sec_per_chip: float
+    # share of measured train wall clock the host spent blocked on the
+    # input feed (profiling.Meter; docs/PIPELINE.md) — the denominator the
+    # next perf round divides host-pipeline work against
+    feed_stall_frac: float = 0.0
+    # aggregated data/feeder.Feeder stats over the run: batches,
+    # feed_stall_s, queue_depth_mean/min, num_workers, depth
+    feeder: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
@@ -165,17 +179,24 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
     # the interval containing the compile step.
     meter = profiling.Meter(warmup=1)
     pending_commits = 0
+    pending_stall = 0.0
     meter.start()
 
     def sync_tick():
         """Record the interval since the last sync, attributing the commits
-        dispatched in it; an empty interval just restarts the clock."""
-        nonlocal pending_commits
+        dispatched in it and the feed-stall time they carried; an empty
+        interval just restarts the clock."""
+        nonlocal pending_commits, pending_stall
         if pending_commits:
-            meter.tick(pending_commits)
-            pending_commits = 0
+            meter.tick(pending_commits, stall_s=pending_stall)
         else:
+            # an empty interval is discarded wholesale — drop its stall too
+            # (e.g. the epoch's pipeline-fill stall at a start-of-epoch dev
+            # gate), or it would be mis-attributed to the NEXT interval and
+            # overstate feed_stall_frac
             meter.start()
+        pending_commits = 0
+        pending_stall = 0.0
 
     # jax.profiler trace of a steady-state step window (skips the compile
     # step); viewable in TensorBoard / xprof.
@@ -218,94 +239,125 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                  else step_lib.jit_accum_step)
         grouped_step = maker(model, cfg, mesh, state, stacked_sample)
 
-    def epoch_feed(epoch: int):
-        """Yield stacked groups then un-stacked tail batches."""
-        it = epoch_batches(train_split, cfg, shuffle=True, seed=cfg.seed,
-                           epoch=epoch)
+    def epoch_tasks(epoch: int):
+        """Zero-arg assembly tasks in the exact deterministic (seed, epoch)
+        batch order: stacked groups then un-stacked tail batches. Each task
+        builds ONE dispatch item, so independent items assemble in parallel
+        on the feeder's workers."""
+        chunks = epoch_index_chunks(len(train_split), cfg, shuffle=True,
+                                    seed=cfg.seed, epoch=epoch)
         if group_size == 1:
-            yield from it
+            yield from assembly_tasks(train_split, chunks, cfg,
+                                      batch_size=cfg.batch_size)
             return
-        group = []
-        for b in it:
-            group.append(b)
-            if len(group) == group_size:
-                yield step_lib.stack_batches(group)
-                group = []
-        if group and accum > 1:
-            # Accum tail: pad to the group shape with all-zero micro-batches
-            # (zero rows have label==0 everywhere, so they contribute nothing
-            # to nll_sum or token count — the same mechanism that makes
-            # make_batch's pad rows free). The tail is then ONE optimizer
-            # step normalized over the real samples' global (sum, count) —
-            # the reference DataLoader's smaller final batch, not up to A-1
-            # separate full steps.
-            pad = jax.tree_util.tree_map(np.zeros_like, group[0])
-            yield step_lib.stack_batches(
-                group + [pad] * (group_size - len(group)))
-        else:
-            yield from group
+
+        def stacked_task(group_chunks):
+            def build():
+                group = [make_batch(train_split, c, cfg,
+                                    batch_size=cfg.batch_size)
+                         for c in group_chunks]
+                if len(group) < group_size:
+                    # Accum tail: pad to the group shape with all-zero
+                    # micro-batches (zero rows have label==0 everywhere, so
+                    # they contribute nothing to nll_sum or token count —
+                    # the same mechanism that makes make_batch's pad rows
+                    # free). The tail is then ONE optimizer step normalized
+                    # over the real samples' global (sum, count) — the
+                    # reference DataLoader's smaller final batch, not up to
+                    # A-1 separate full steps.
+                    pad = jax.tree_util.tree_map(np.zeros_like, group[0])
+                    group = group + [pad] * (group_size - len(group))
+                return step_lib.stack_batches(group)
+            return build
+
+        for start in range(0, len(chunks), group_size):
+            grp = chunks[start : start + group_size]
+            if len(grp) == group_size or accum > 1:
+                yield stacked_task(grp)
+            else:  # fused tail (< K batches) runs per-step
+                yield from assembly_tasks(train_split, grp, cfg,
+                                          batch_size=cfg.batch_size)
+
+    # Aggregated feeder stats across epochs (each epoch gets a fresh
+    # pipeline; sums/mins fold here for TrainResult)
+    feed_totals = {"batches": 0.0, "feed_stall_s": 0.0,
+                   "queue_depth_sum": 0.0, "queue_depth_min": float("inf")}
 
     for epoch in range(start_epoch, n_epochs):
         last_metrics = None
         idx = 0  # batch index of the current item's first step
-        for batch, n_valid in prefetch_to_device(
-            epoch_feed(epoch), sharding=batch_sharding,
-        ):
-            stacked = batch["valid"].ndim == 2
-            # cadence counts REAL batches: the accum tail is padded with
-            # all-zero micro-batches, so the stacked leading dim overstates
-            # it — n_valid (host-side, no sync) recovers the real count
-            # exactly because only a group's last real batch can be partial
-            k = -(-n_valid // cfg.batch_size) if stacked else 1
-            # does [idx, idx+k) contain a multiple of the cadence?
-            gate_due = (-idx) % cfg.dev_every_batches < k
-            log_due = (-idx) % 10 < k
-            if epoch >= cfg.dev_start_epoch and gate_due:
-                if last_metrics is not None:
-                    _materialize(last_metrics["loss"])
-                sync_tick()
-                meter.pause()  # dev time is not train time
-                cur_bleu, dev_text = run_dev(dev_step, state.params, dataset,
-                                             cfg, var_maps, guard=guard)
-                better = cur_bleu > best_bleu
-                log.gate(epoch, idx, cur_bleu, better)
-                if better:
-                    best_bleu = cur_bleu
-                    ckpt.save_best(state.params)
-                    log.dev_output(dev_text)
-                meter.start()
+        epoch_feed = Feeder(epoch_tasks(epoch),
+                            num_workers=cfg.feeder_workers,
+                            depth=cfg.feeder_depth, sharding=batch_sharding)
+        try:
+            for item in epoch_feed:
+                batch, n_valid = item.device, item.n_valid
+                pending_stall += item.stall_s
+                stacked = item.host["valid"].ndim == 2
+                # cadence counts REAL batches: the accum tail is padded with
+                # all-zero micro-batches, so the stacked leading dim overstates
+                # it — n_valid (host-side, no sync) recovers the real count
+                # exactly because only a group's last real batch can be partial
+                k = -(-n_valid // cfg.batch_size) if stacked else 1
+                # does [idx, idx+k) contain a multiple of the cadence?
+                gate_due = (-idx) % cfg.dev_every_batches < k
+                log_due = (-idx) % 10 < k
+                if epoch >= cfg.dev_start_epoch and gate_due:
+                    if last_metrics is not None:
+                        _materialize(last_metrics["loss"])
+                    sync_tick()
+                    meter.pause()  # dev time is not train time
+                    cur_bleu, dev_text = run_dev(dev_step, state.params, dataset,
+                                                 cfg, var_maps, guard=guard)
+                    better = cur_bleu > best_bleu
+                    log.gate(epoch, idx, cur_bleu, better)
+                    if better:
+                        best_bleu = cur_bleu
+                        ckpt.save_best(state.params)
+                        log.dev_output(dev_text)
+                    meter.start()
 
-            if profile_window and global_step == profile_window[0]:
-                jax.profiler.start_trace(profile_dir)
-                profiling_active = True
-            if profiling_active:  # fused==1 here (forced above)
-                with profiling.step_annotation(global_step):
+                if profile_window and global_step == profile_window[0]:
+                    jax.profiler.start_trace(profile_dir)
+                    profiling_active = True
+                if profiling_active:  # fused==1 here (forced above)
+                    with profiling.step_annotation(global_step):
+                        state, metrics = train_step(state, batch)
+                    if global_step == profile_window[-1]:
+                        _materialize(metrics["loss"])
+                        jax.profiler.stop_trace()
+                        profiling_active = False
+                        log.console(f"profile trace written to {profile_dir}")
+                elif stacked:
+                    state, metrics = grouped_step(state, batch)
+                else:
                     state, metrics = train_step(state, batch)
-                if global_step == profile_window[-1]:
-                    _materialize(metrics["loss"])
-                    jax.profiler.stop_trace()
-                    profiling_active = False
-                    log.console(f"profile trace written to {profile_dir}")
-            elif stacked:
-                state, metrics = grouped_step(state, batch)
-            else:
-                state, metrics = train_step(state, batch)
-            if guard is not None:
-                # compile-once contract: a post-warmup dispatch of either
-                # program that recompiles raises RetraceError here
-                guard.step("grouped_step" if stacked else "train_step")
-            # a fused group is k steps; an accumulation group is ONE step
-            global_step += 1 if (stacked and accum > 1) else k
-            last_metrics = metrics
-            pending_commits += n_valid
-            if log_due:
-                # blocks; a stacked dispatch reports its last step's loss
-                # firacheck: allow[HOST-SYNC] the 10-batch console-log cadence is a designated sync boundary (README Design notes); steps in between stay async-dispatched
-                loss = float(np.asarray(
-                    jax.device_get(metrics["loss"])).ravel()[-1])  # firacheck: allow[HOST-SYNC] same log boundary — the expression's device_get continues onto this line
-                sync_tick()
-                log.console(f"epoch: {epoch} batch: {idx} loss: {loss:.4f}")
-            idx += k
+                if guard is not None:
+                    # compile-once contract: a post-warmup dispatch of either
+                    # program that recompiles raises RetraceError here
+                    guard.step("grouped_step" if stacked else "train_step")
+                # a fused group is k steps; an accumulation group is ONE step
+                global_step += 1 if (stacked and accum > 1) else k
+                last_metrics = metrics
+                pending_commits += n_valid
+                if log_due:
+                    # blocks; a stacked dispatch reports its last step's loss
+                    # firacheck: allow[HOST-SYNC] the 10-batch console-log cadence is a designated sync boundary (README Design notes); steps in between stay async-dispatched
+                    loss = float(np.asarray(
+                        jax.device_get(metrics["loss"])).ravel()[-1])  # firacheck: allow[HOST-SYNC] same log boundary — the expression's device_get continues onto this line
+                    sync_tick()
+                    log.console(f"epoch: {epoch} batch: {idx} loss: {loss:.4f}")
+                idx += k
+        finally:
+            # clean pipeline shutdown on ANY exit (error, interrupt, normal
+            # exhaustion): no worker threads survive the epoch
+            s = epoch_feed.stats()
+            feed_totals["batches"] += s["batches"]
+            feed_totals["feed_stall_s"] += s["feed_stall_s"]
+            feed_totals["queue_depth_sum"] += s["queue_depth_sum"]
+            feed_totals["queue_depth_min"] = min(
+                feed_totals["queue_depth_min"], s["queue_depth_min"])
+            epoch_feed.close()
         if last_metrics is not None:
             _materialize(last_metrics["loss"])
         sync_tick()
@@ -322,10 +374,32 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                     f"{global_step} steps, before the profile window "
                     f"(starts at step {profile_window[0]})")
 
-    cps = meter.summary()["items_per_sec"] / n_chips
+    msum = meter.summary()
+    cps = msum["items_per_sec"] / n_chips
+    n_fed = feed_totals["batches"]
+    feeder_stats = {
+        "batches": n_fed,
+        "feed_stall_s": round(feed_totals["feed_stall_s"], 4),
+        "queue_depth_mean": round(
+            feed_totals["queue_depth_sum"] / n_fed, 2) if n_fed else 0.0,
+        "queue_depth_min": (feed_totals["queue_depth_min"]
+                            if n_fed else 0.0),
+        "num_workers": float(cfg.feeder_workers),
+        "depth": float(cfg.feeder_depth),
+    }
+    if n_fed:
+        log.console(
+            f"throughput: {cps:.2f} commits/sec/chip | feed_stall_frac "
+            f"{msum['feed_stall_frac']:.3f} "
+            f"({msum['feed_stall_ms_per_step']:.1f} ms/step) | feeder "
+            f"queue depth mean {feeder_stats['queue_depth_mean']:.1f} "
+            f"min {feeder_stats['queue_depth_min']:.0f} "
+            f"(workers {cfg.feeder_workers}, depth {cfg.feeder_depth})")
     # epochs ACTUALLY executed this call (a resumed run skips start_epoch of
     # them; a checkpoint already past the target runs zero) — callers
     # validating resume legs depend on the distinction
     return TrainResult(state=state, best_bleu=best_bleu,
                        epochs_run=max(0, n_epochs - start_epoch),
-                       commits_per_sec_per_chip=cps)
+                       commits_per_sec_per_chip=cps,
+                       feed_stall_frac=msum["feed_stall_frac"],
+                       feeder=feeder_stats)

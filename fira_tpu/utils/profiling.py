@@ -43,16 +43,21 @@ def step_annotation(step: int):
 
 @dataclasses.dataclass
 class Meter:
-    """Steady-state throughput/latency meter.
+    """Steady-state throughput/latency meter with feed-stall attribution.
 
     ``warmup`` leading intervals are discarded (they contain compilation).
-    Call ``tick(n_items)`` once per completed step after syncing with the
-    device; read ``summary()`` at the end.
+    Call ``tick(n_items, stall_s=...)`` once per completed step after
+    syncing with the device — ``stall_s`` is how much of the interval the
+    host spent blocked waiting on the input feed (data/feeder.py hands it
+    per batch); read ``summary()`` at the end. ``feed_stall_frac`` is the
+    denominator the next perf round needs: the share of steady-state wall
+    clock that was feed, not device compute.
     """
 
     warmup: int = 1
     _intervals: List[float] = dataclasses.field(default_factory=list)
     _items: List[int] = dataclasses.field(default_factory=list)
+    _stalls: List[float] = dataclasses.field(default_factory=list)
     _last: Optional[float] = None
     _seen: int = 0
 
@@ -63,30 +68,37 @@ class Meter:
         """Exclude the time until the next start() (e.g. a dev-eval pass)."""
         self._last = None
 
-    def tick(self, n_items: int = 1) -> None:
+    def tick(self, n_items: int = 1, stall_s: float = 0.0) -> None:
         now = time.perf_counter()
         if self._last is not None:
             self._seen += 1
             if self._seen > self.warmup:
                 self._intervals.append(now - self._last)
                 self._items.append(n_items)
+                self._stalls.append(stall_s)
         self._last = now
 
     def summary(self) -> Dict[str, float]:
         if not self._intervals:
             return {"steps": 0, "items_per_sec": 0.0,
                     "mean_step_ms": 0.0, "p50_step_ms": 0.0,
-                    "p99_step_ms": 0.0}
+                    "p99_step_ms": 0.0, "feed_stall_frac": 0.0,
+                    "feed_stall_ms_per_step": 0.0}
         total_t = sum(self._intervals)
         xs = sorted(self._intervals)
 
         def pct(p: float) -> float:
             return xs[min(len(xs) - 1, int(p * len(xs)))]
 
+        total_stall = sum(self._stalls)
         return {
             "steps": float(len(xs)),
             "items_per_sec": sum(self._items) / total_t,
             "mean_step_ms": 1e3 * total_t / len(xs),
             "p50_step_ms": 1e3 * pct(0.50),
             "p99_step_ms": 1e3 * pct(0.99),
+            # share of measured wall clock the host spent blocked on the
+            # input feed (assembly + transfer not hidden behind compute)
+            "feed_stall_frac": min(1.0, total_stall / total_t),
+            "feed_stall_ms_per_step": 1e3 * total_stall / len(xs),
         }

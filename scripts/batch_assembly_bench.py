@@ -1,0 +1,109 @@
+"""Microbench: vectorized vs loop ``make_batch`` edge gather.
+
+The vectorized path (data/batching._gather_edges_vectorized) vectorizes
+the gather addressing and picks a copy regime by mean edges per row (see
+``_VEC_EDGE_CROSSOVER`` there): a flat cumsum/np.repeat gather in the
+many-rows/few-edges regime, per-row contiguous slice copies (near-memcpy)
+in the dense-edge flagship regime. This script measures the gather in
+isolation AND the full make_batch call both ways (the golden test pins
+them bit-exact) at both geometries, and prints the ratios quoted in the
+PR description.
+
+Usage: python scripts/batch_assembly_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from fira_tpu.config import fira_full, fira_tiny  # noqa: E402
+from fira_tpu.data.batching import (  # noqa: E402
+    _gather_edges_loop,
+    _gather_edges_vectorized,
+    make_batch,
+)
+from fira_tpu.data.synthetic import make_memory_split, thin_edges  # noqa: E402
+
+
+def _best_pair(fn_a, fn_b, reps: int):
+    """Best-of-reps seconds for two contenders, INTERLEAVED rep by rep so
+    clock drift (throttling, background load) hits both equally; min is
+    the honest microbench statistic on a contended host. One untimed
+    warmup rep each."""
+    ta, tb = [], []
+    for r in range(reps + 1):
+        t0 = time.perf_counter()
+        fn_a()
+        t1 = time.perf_counter()
+        fn_b()
+        t2 = time.perf_counter()
+        if r:
+            ta.append(t1 - t0)
+            tb.append(t2 - t1)
+    return min(ta), min(tb)
+
+
+def bench_geometry(name: str, cfg0, batch_size: int, n_data: int,
+                   reps: int = 5, thin: int = 0) -> dict:
+    cfg, split, _ = make_memory_split(cfg0, n_data, seed=0)
+    if thin:
+        split = thin_edges(split, thin)
+    rng = np.random.RandomState(0)
+    index_sets = [rng.choice(n_data, batch_size, replace=True)
+                  for _ in range(8)]
+    mean_edges = float(np.diff(split.arrays["edge_offsets"]).mean())
+
+    def gather_all(fn):
+        for ix in index_sets:
+            fn(split, ix, cfg, batch_size)
+
+    def batch_all(gather: str):
+        for ix in index_sets:
+            make_batch(split, ix, cfg, edge_gather=gather)
+
+    n = len(index_sets)
+    g_loop, g_vec = _best_pair(lambda: gather_all(_gather_edges_loop),
+                               lambda: gather_all(_gather_edges_vectorized),
+                               reps)
+    b_loop, b_vec = _best_pair(lambda: batch_all("loop"),
+                               lambda: batch_all("vectorized"), reps)
+    g_loop, g_vec, b_loop, b_vec = (t / n for t in
+                                    (g_loop, g_vec, b_loop, b_vec))
+    return {
+        "config": name,
+        "batch_size": batch_size,
+        "max_edges": cfg.max_edges,
+        "mean_edges_per_sample": round(mean_edges, 1),
+        "gather_loop_ms": round(1e3 * g_loop, 3),
+        "gather_vectorized_ms": round(1e3 * g_vec, 3),
+        "gather_speedup": round(g_loop / g_vec, 2),
+        "make_batch_loop_ms": round(1e3 * b_loop, 3),
+        "make_batch_vectorized_ms": round(1e3 * b_vec, 3),
+        "make_batch_speedup": round(b_loop / b_vec, 2),
+    }
+
+
+def main() -> int:
+    results = [
+        # many rows, few edges: the flat cumsum/np.repeat regime
+        bench_geometry("fira-tiny/680-sparse", fira_tiny(sort_edges=True),
+                       680, 1024, thin=24),
+        # mid-density tiny corpus (slice regime just above the crossover)
+        bench_geometry("fira-tiny/170", fira_tiny(sort_edges=True), 170, 256),
+        # flagship: dense edges, the slice-copy regime
+        bench_geometry("fira-full/170", fira_full(sort_edges=True), 170, 256),
+    ]
+    for r in results:
+        print(json.dumps(r))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

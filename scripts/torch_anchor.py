@@ -1,0 +1,244 @@
+"""Measure the reference torch stack's training throughput ON THIS HOST.
+
+Every vs_baseline in the repo's bench artifacts divides by an ESTIMATED
+340 commits/sec/chip for the reference stack (bench.py docstring derives
+it from PCIe math and an optimistic 0.5 s step). VERDICT round 5 asked for
+a measured denominator. The reference repo itself is not mounted in this
+container, so this script reconstructs the reference TRAINING STEP at the
+paper geometry in torch, input-pipeline sins included:
+
+- dense 650^2 float32 adjacency assembled PER SAMPLE inside the Dataset
+  (the reference's Dataset.py:336-343 densification — ~1.7 MB/sample,
+  ~287 MB/batch-170 on the wire);
+- torch DataLoader collation, then a BLOCKING ``.to(device)`` per batch
+  (run_model.py:94-101 ``.cuda()`` — no prefetch, no overlap);
+- d=256 embeddings, 6 GCN rounds as adjacency bmm + two d*d projections,
+  per-round self-attention over the 210 diff positions, a 6-layer
+  transformer decoder (8 heads, FFN 4d) over tar_len=30 with 370-key
+  cross-attention, a fused 25,020-way output head plus copy-score
+  projections; fwd + CE loss + backward + Adam per step.
+
+One honest deviation: the copy head scores are computed as a bilinear
+tgt_proj(dec) @ src_proj(mem)^T contraction instead of the reference's
+materialized (B,T,S,D) tanh intermediate — that intermediate is ~1.9 GB
+f32 at batch 170 and would OOM a laptop-class host; the bilinear form
+keeps the same projection/contraction matmul terms. The deviation is
+recorded in the emitted JSON.
+
+Output: one JSON file (default <repo>/TORCH_ANCHOR.json, next to
+BASELINE.json) with commits_per_sec_per_chip measured end-to-end (data
+assembly + transfer + step, the reference's real loop) plus a
+compute-only step timing for apples-to-apples against bench.py's
+``value_basis: compute``. bench.py reports ``vs_torch_anchor`` when the
+file exists.
+
+Env knobs: TORCH_ANCHOR_BATCH (default 170), TORCH_ANCHOR_STEPS (timed
+steps, default 2), TORCH_ANCHOR_DATA (corpus size, default 4*batch),
+TORCH_ANCHOR_EDGES (COO edges densified per sample, default 3000 — the
+full-scale corpus p50 band), TORCH_ANCHOR_DEVICE (default cuda-if-there
+else cpu), TORCH_ANCHOR_OUT (output path).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# reference geometry (run_model.py:30-46 / Model.py:81)
+SOU_LEN, TAR_LEN, SUB_LEN, AST_LEN = 210, 30, 160, 280
+GRAPH_LEN = SOU_LEN + SUB_LEN + AST_LEN          # 650
+D, HEADS, LAYERS, FFN = 256, 8, 6, 1024
+VOCAB, OUT_VOCAB = 24650, 24650 + SOU_LEN + SUB_LEN   # 25020
+
+
+def build(torch):
+    import torch.nn as nn
+
+    class RefModel(nn.Module):
+        """Reference-geometry GNN encoder + transformer decoder + fused
+        output/copy heads (see module docstring for the one deviation)."""
+
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(VOCAB, D)
+            self.gcn_fc1 = nn.ModuleList(nn.Linear(D, D) for _ in range(LAYERS))
+            self.gcn_fc2 = nn.ModuleList(nn.Linear(D, D) for _ in range(LAYERS))
+            self.comb = nn.ModuleList(
+                nn.MultiheadAttention(D, HEADS, batch_first=True)
+                for _ in range(LAYERS))
+            dec_layer = nn.TransformerDecoderLayer(
+                d_model=D, nhead=HEADS, dim_feedforward=FFN,
+                batch_first=True, dropout=0.1)
+            self.decoder = nn.TransformerDecoder(dec_layer, LAYERS)
+            self.out_fc = nn.Linear(D, OUT_VOCAB)
+            self.copy_src = nn.Linear(D, D)
+            self.copy_tgt = nn.Linear(D, D)
+
+        def forward(self, tokens, adj, msg):
+            x = self.embed(tokens)                       # (B, 650, D)
+            for fc1, fc2, att in zip(self.gcn_fc1, self.gcn_fc2, self.comb):
+                x = x + torch.relu(fc2(torch.relu(fc1(adj.bmm(x)))))
+                diff = x[:, :SOU_LEN]
+                mixed, _ = att(diff, diff, diff, need_weights=False)
+                x = torch.cat([diff + mixed, x[:, SOU_LEN:]], dim=1)
+            mem = x[:, : SOU_LEN + SUB_LEN]              # (B, 370, D)
+            tgt = self.embed(msg)                        # (B, 30, D)
+            dec = self.decoder(tgt, mem)
+            gen = self.out_fc(dec)                       # (B, 30, 25020)
+            copy = torch.tanh(self.copy_tgt(dec)).bmm(
+                self.copy_src(mem).transpose(1, 2))      # (B, 30, 370)
+            return gen, copy
+
+    return RefModel()
+
+
+def make_dataset(torch, np, n_data: int, n_edges: int):
+    from torch.utils.data import Dataset
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(1, VOCAB, size=(n_data, GRAPH_LEN)).astype(np.int64)
+    msgs = rng.randint(1, VOCAB, size=(n_data, TAR_LEN)).astype(np.int64)
+    labels = rng.randint(1, OUT_VOCAB, size=(n_data, TAR_LEN)).astype(np.int64)
+    send = rng.randint(0, GRAPH_LEN, size=(n_data, n_edges))
+    recv = rng.randint(0, GRAPH_LEN, size=(n_data, n_edges))
+
+    class DenseAdjacencyDataset(Dataset):
+        """Densifies the 650^2 adjacency per __getitem__ — the reference's
+        Dataset.py:336-343 behavior this anchor exists to price in."""
+
+        def __len__(self):
+            return n_data
+
+        def __getitem__(self, i):
+            adj = np.zeros((GRAPH_LEN, GRAPH_LEN), dtype=np.float32)
+            adj[send[i], recv[i]] = 1.0
+            return (torch.from_numpy(tokens[i]), torch.from_numpy(adj),
+                    torch.from_numpy(msgs[i]), torch.from_numpy(labels[i]))
+
+    return DenseAdjacencyDataset()
+
+
+def main() -> int:
+    try:
+        import numpy as np
+        import torch
+    except ImportError as e:  # container without torch: structured no-result
+        out = {"metric": "torch_reference_commits_per_sec_per_chip",
+               "commits_per_sec_per_chip": None,
+               "error": f"torch unavailable: {e}"}
+        print(json.dumps(out))
+        return 1
+
+    batch = int(os.environ.get("TORCH_ANCHOR_BATCH", "170"))
+    n_steps = int(os.environ.get("TORCH_ANCHOR_STEPS", "2"))
+    n_data = int(os.environ.get("TORCH_ANCHOR_DATA", str(4 * batch)))
+    n_edges = int(os.environ.get("TORCH_ANCHOR_EDGES", "3000"))
+    dev_name = os.environ.get(
+        "TORCH_ANCHOR_DEVICE",
+        "cuda" if torch.cuda.is_available() else "cpu")
+    out_path = os.environ.get("TORCH_ANCHOR_OUT",
+                              os.path.join(REPO, "TORCH_ANCHOR.json"))
+    device = torch.device(dev_name)
+
+    from torch.utils.data import DataLoader
+
+    loader = DataLoader(make_dataset(torch, np, n_data, n_edges),
+                        batch_size=batch, shuffle=True)
+    model = build(torch).to(device)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-4)
+    ce = torch.nn.CrossEntropyLoss()
+
+    def step(tokens, adj, msg, labels):
+        opt.zero_grad()
+        gen, copy = model(tokens, adj, msg)
+        # fused loss surface: CE over the 25,020-way head, with the copy
+        # scores folded in so backward runs through both heads
+        loss = ce(gen.reshape(-1, OUT_VOCAB), labels.reshape(-1))
+        loss = loss + 1e-3 * copy.float().pow(2).mean()
+        loss.backward()
+        opt.step()
+        return float(loss.detach())
+
+    def sync():
+        if device.type == "cuda":
+            torch.cuda.synchronize()
+
+    it = iter(loader)
+
+    def next_batch():
+        nonlocal it
+        try:
+            return next(it)
+        except StopIteration:
+            it = iter(loader)
+            return next(it)
+
+    # warmup: one full end-to-end step (allocator + autotune)
+    host = next_batch()
+    step(*(t.to(device) for t in host))
+    sync()
+
+    # (a) end-to-end: DataLoader assembly + blocking transfer + step —
+    # the reference's actual loop shape
+    e2e_times, losses = [], []
+    t_all = time.perf_counter()
+    for _ in range(n_steps):
+        t0 = time.perf_counter()
+        host = next_batch()                       # densify on this thread
+        moved = [t.to(device) for t in host]      # blocking H2D
+        losses.append(step(*moved))
+        sync()
+        e2e_times.append(time.perf_counter() - t0)
+    wall = time.perf_counter() - t_all
+
+    # (b) compute-only: device-resident batch, same step — the basis
+    # bench.py's metric of record uses
+    compute_times = []
+    for _ in range(n_steps):
+        t0 = time.perf_counter()
+        step(*moved)
+        sync()
+        compute_times.append(time.perf_counter() - t0)
+
+    if not all(math.isfinite(l) for l in losses):
+        print(f"non-finite loss in anchor run: {losses}", file=sys.stderr)
+        return 1
+
+    e2e = sum(e2e_times) / len(e2e_times)
+    comp = sum(compute_times) / len(compute_times)
+    record = {
+        "metric": "torch_reference_commits_per_sec_per_chip",
+        "commits_per_sec_per_chip": round(batch / e2e, 2),
+        "commits_per_sec_per_chip_compute": round(batch / comp, 2),
+        "step_time_s": round(e2e, 4),
+        "compute_step_time_s": round(comp, 4),
+        "batch_size": batch,
+        "n_steps": n_steps,
+        "device": dev_name,
+        "device_name": (torch.cuda.get_device_name(0)
+                        if device.type == "cuda" else "cpu"),
+        "torch_version": torch.__version__,
+        "torch_threads": torch.get_num_threads(),
+        "wall_s": round(wall, 2),
+        "geometry": {"graph_len": GRAPH_LEN, "sou_len": SOU_LEN,
+                     "tar_len": TAR_LEN, "d": D, "layers": LAYERS,
+                     "out_vocab": OUT_VOCAB, "edges_per_sample": n_edges},
+        "model": "reference-geometry torch reconstruction "
+                 "(dense 650^2 per-sample adjacency, blocking per-batch "
+                 "transfer; copy head as bilinear contraction — see "
+                 "scripts/torch_anchor.py docstring)",
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,88 @@
+"""Golden pin for the vectorized batch assembly: ``make_batch``'s flat
+cumsum/np.repeat COO gather must be BIT-exact against the pre-refactor
+per-row loop (kept in data/batching.py as ``_gather_edges_loop`` solely as
+this test's oracle), through the FULL batch path — narrow wire dtypes,
+sorted-edge permutation, bf16 wire values, typed edge kinds, partial-batch
+padding. Comparison is on raw bytes, not values: a dtype or layout drift
+fails even where values would compare equal."""
+
+import numpy as np
+import pytest
+
+from fira_tpu.config import fira_tiny
+from fira_tpu.data.batching import make_batch
+from fira_tpu.data.synthetic import make_memory_split
+
+CASES = [
+    ("default", {}),
+    ("sorted", {"sort_edges": True}),
+    ("typed_edges", {"typed_edges": True, "sort_edges": True}),
+    # the bf16 wire path is gated on exactly bf16 + dense + untyped
+    ("bf16_wire", {"compute_dtype": "bfloat16", "adjacency_impl": "dense",
+                   "sort_edges": True}),
+    ("segment", {"adjacency_impl": "segment"}),
+]
+
+
+@pytest.fixture(scope="module")
+def corpus_split():
+    cfg, split, _ = make_memory_split(fira_tiny(), 64, seed=11)
+    return cfg, split
+
+
+@pytest.fixture(scope="module")
+def sparse_split(corpus_split):
+    from fira_tpu.data.synthetic import thin_edges
+
+    _, split = corpus_split
+    # below the gather's flat-regime crossover: pins BOTH copy regimes
+    return thin_edges(split, 24)
+
+
+@pytest.mark.parametrize("edge_density", ["dense", "sparse"])
+@pytest.mark.parametrize("overrides", [c[1] for c in CASES],
+                         ids=[c[0] for c in CASES])
+def test_vectorized_gather_bit_exact_vs_loop(corpus_split, sparse_split,
+                                             overrides, edge_density):
+    cfg0, split = corpus_split
+    if edge_density == "sparse":  # flat copy regime (below the crossover)
+        split = sparse_split
+    cfg = cfg0.replace(**overrides)
+    rng = np.random.RandomState(3)
+    index_sets = [
+        np.arange(16),                   # contiguous
+        rng.permutation(64)[:16],        # shuffled gather
+        np.arange(5),                    # partial batch -> pad rows
+        rng.choice(64, 16, replace=True),  # repeated samples
+    ]
+    for idx in index_sets:
+        vec = make_batch(split, idx, cfg, batch_size=16)
+        ref = make_batch(split, idx, cfg, batch_size=16, edge_gather="loop")
+        assert set(vec) == set(ref)
+        for k in ref:
+            a, b = vec[k], ref[k]
+            assert a.shape == b.shape, k
+            assert a.dtype == b.dtype, k
+            assert a.tobytes() == b.tobytes(), f"field {k!r} differs"
+
+
+def test_vectorized_gather_overflow_error_matches_loop(corpus_split):
+    cfg0, split = corpus_split
+    cfg = cfg0.replace(max_edges=1)  # every sample exceeds this
+    idx = np.arange(4)
+    with pytest.raises(ValueError) as e_vec:
+        make_batch(split, idx, cfg, batch_size=4)
+    with pytest.raises(ValueError) as e_loop:
+        make_batch(split, idx, cfg, batch_size=4, edge_gather="loop")
+    # same offending sample, same message (the first row in batch order)
+    assert str(e_vec.value) == str(e_loop.value)
+
+
+def test_empty_indices_ok(corpus_split):
+    cfg, split = corpus_split
+    vec = make_batch(split, np.arange(0), cfg, batch_size=4)
+    ref = make_batch(split, np.arange(0), cfg, batch_size=4,
+                     edge_gather="loop")
+    for k in ref:
+        assert vec[k].tobytes() == ref[k].tobytes(), k
+    assert not vec["valid"].any()

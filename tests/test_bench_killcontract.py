@@ -30,6 +30,9 @@ def _kill_after(delay_s: float, env_extra: dict) -> str:
         "FIRA_BENCH_PROBE_BUDGET": "30",
         "FIRA_BENCH_RETRY_SLEEP": "0",
         "FIRA_BENCH_PROBE_RETRY_SLEEP": "0",
+        # identical-failure backoff off: these tests pin the kill contract
+        # mid-probe-loop, so the loop must keep looping until the kill
+        "FIRA_BENCH_PROBE_IDENTICAL_LIMIT": "0",
     })
     env.update(env_extra)
     with tempfile.TemporaryFile(mode="w+") as out:
@@ -81,6 +84,7 @@ def test_budget_exhaustion_emits_final_record():
         "FIRA_BENCH_PROBE_BUDGET": "3",
         "FIRA_BENCH_RETRY_SLEEP": "0",
         "FIRA_BENCH_PROBE_RETRY_SLEEP": "0",
+        "FIRA_BENCH_PROBE_IDENTICAL_LIMIT": "0",  # pin the budget path
     })
     p = subprocess.run([sys.executable, BENCH], capture_output=True,
                        text=True, timeout=60, env=env, cwd=REPO)
@@ -109,3 +113,30 @@ def test_status_records_updated_every_probe():
     # later records carry the probe attempts
     assert any("probe attempt" in (r.get("error") or "")
                for r in in_progress), lines
+
+
+def test_identical_probe_failures_abort_early():
+    # BENCH_r05 burned the full 900 s budget on 7 byte-identical 90-s
+    # backend-init timeouts. The backoff contract: after N consecutive
+    # identical-signature probe failures, emit the structured final record
+    # and exit — well before the budget deadline.
+    env = dict(os.environ)
+    env.update({
+        "FIRA_BENCH_TEST_HANG_S": "999",     # every probe hangs identically
+        "FIRA_BENCH_PROBE_TIMEOUT": "1",
+        "FIRA_BENCH_PROBE_BUDGET": "120",    # would burn 2 min without backoff
+        "FIRA_BENCH_PROBE_RETRY_SLEEP": "0",
+        "FIRA_BENCH_PROBE_IDENTICAL_LIMIT": "3",
+    })
+    t0 = time.time()
+    p = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, timeout=90, env=env, cwd=REPO)
+    elapsed = time.time() - t0
+    rec = _last_json_line(p.stdout)
+    assert p.returncode != 0
+    assert elapsed < 60, f"backoff did not fire ({elapsed:.0f}s)"
+    assert rec["value"] is None
+    assert not rec.get("in_progress"), rec
+    assert "identical probe failures" in rec["error"], rec
+    assert sum(1 for a in rec["attempts"]
+               if isinstance(a, dict) and a.get("phase") == "probe") == 3

@@ -1,0 +1,188 @@
+"""Feeder contracts (data/feeder.py; docs/PIPELINE.md):
+
+- determinism: the same (seed, epoch) yields a byte-identical batch
+  sequence whether assembly runs synchronously (num_workers=0) or on any
+  worker-pool size;
+- failure: a worker/dispatcher exception surfaces at the consumer within
+  one step, never silently truncating the stream;
+- shutdown: every exit path (exhaustion, early break, error) leaves no
+  live pipeline threads;
+- observability: stall/queue-depth accounting behaves sanely in both
+  modes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fira_tpu.config import fira_tiny
+from fira_tpu.data.batching import epoch_index_chunks
+from fira_tpu.data.feeder import Feeder, assembly_tasks
+from fira_tpu.data.synthetic import make_memory_split
+
+
+@pytest.fixture(scope="module")
+def corpus_split():
+    cfg, split, _ = make_memory_split(fira_tiny(batch_size=8), 32, seed=9)
+    return cfg, split
+
+
+def _feeder_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("fira-feeder")]
+
+
+def _host_sequence(cfg, split, num_workers, epoch):
+    chunks = epoch_index_chunks(len(split), cfg, shuffle=True,
+                                seed=cfg.seed, epoch=epoch)
+    with Feeder(assembly_tasks(split, chunks, cfg,
+                               batch_size=cfg.batch_size),
+                num_workers=num_workers, depth=3, put=False) as feed:
+        return [item.host for item in feed]
+
+
+def _assert_same_batches(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert set(x) == set(y)
+        for k in x:
+            assert x[k].tobytes() == y[k].tobytes(), k
+
+
+class TestDeterminism:
+    def test_same_seed_epoch_byte_identical_any_worker_count(self,
+                                                             corpus_split):
+        cfg, split = corpus_split
+        for epoch in (0, 1):
+            sync, w1, w4 = (_host_sequence(cfg, split, w, epoch)
+                            for w in (0, 1, 4))
+            _assert_same_batches(sync, w1)
+            _assert_same_batches(sync, w4)
+
+    def test_epochs_draw_different_permutations(self, corpus_split):
+        cfg, split = corpus_split
+        e0 = _host_sequence(cfg, split, 2, epoch=0)
+        e1 = _host_sequence(cfg, split, 2, epoch=1)
+        assert any(x["diff"].tobytes() != y["diff"].tobytes()
+                   for x, y in zip(e0, e1))
+
+    def test_items_numbered_in_order_with_valid_counts(self, corpus_split):
+        cfg, split = corpus_split
+        chunks = epoch_index_chunks(len(split), cfg, batch_size=5)
+        with Feeder(assembly_tasks(split, chunks, cfg, batch_size=5),
+                    num_workers=3, put=False) as feed:
+            items = list(feed)
+        assert [i.index for i in items] == list(range(len(chunks)))
+        assert [i.n_valid for i in items] == [len(c) for c in chunks]
+
+
+class TestFailure:
+    def test_worker_exception_surfaces_within_one_step(self, corpus_split):
+        cfg, split = corpus_split
+        chunks = epoch_index_chunks(len(split), cfg, batch_size=8)
+        good = list(assembly_tasks(split, chunks, cfg, batch_size=8))
+
+        def boom():
+            raise RuntimeError("poisoned assembly task")
+
+        tasks = [good[0], boom] + good[1:]
+        consumed = 0
+        with pytest.raises(RuntimeError, match="poisoned assembly task"):
+            with Feeder(iter(tasks), num_workers=2, put=False) as feed:
+                for _ in feed:
+                    consumed += 1
+        # the error may pre-empt ready items, but must never be deferred
+        # past the failing task's position in the stream
+        assert consumed <= 1
+        assert not _feeder_threads()
+
+    def test_raising_task_generator_poisons_feed(self, corpus_split):
+        cfg, split = corpus_split
+        chunks = epoch_index_chunks(len(split), cfg, batch_size=8)
+        good = list(assembly_tasks(split, chunks, cfg, batch_size=8))
+
+        def tasks():
+            yield good[0]
+            raise ValueError("generator blew up")
+
+        with pytest.raises(ValueError, match="generator blew up"):
+            with Feeder(tasks(), num_workers=2, put=False) as feed:
+                list(feed)
+        assert not _feeder_threads()
+
+    def test_sync_mode_propagates_immediately(self):
+        def boom():
+            raise KeyError("sync boom")
+
+        feed = Feeder(iter([boom]), num_workers=0, put=False)
+        with pytest.raises(KeyError, match="sync boom"):
+            next(feed)
+
+
+class TestShutdown:
+    def test_exhaustion_leaves_no_threads(self, corpus_split):
+        cfg, split = corpus_split
+        _host_sequence(cfg, split, 3, epoch=0)
+        assert not _feeder_threads()
+
+    def test_early_break_close_leaves_no_threads(self, corpus_split):
+        cfg, split = corpus_split
+        chunks = epoch_index_chunks(len(split), cfg, batch_size=8)
+        with Feeder(assembly_tasks(split, chunks, cfg, batch_size=8),
+                    num_workers=2, depth=2, put=False) as feed:
+            next(feed)  # abandon mid-stream
+        assert not _feeder_threads()
+
+    def test_close_is_idempotent(self, corpus_split):
+        cfg, split = corpus_split
+        chunks = epoch_index_chunks(len(split), cfg, batch_size=8)
+        feed = Feeder(assembly_tasks(split, chunks, cfg, batch_size=8),
+                      num_workers=1, put=False)
+        next(feed)
+        feed.close()
+        feed.close()
+        assert not _feeder_threads()
+
+
+class TestObservability:
+    def test_sync_mode_counts_assembly_as_stall(self):
+        def slow():
+            time.sleep(0.01)
+            return {"valid": np.ones(2, bool)}
+
+        with Feeder(iter([slow, slow]), num_workers=0, put=False) as feed:
+            items = list(feed)
+        assert all(i.stall_s >= 0.005 for i in items)
+        s = feed.stats()
+        assert s["batches"] == 2
+        assert s["feed_stall_s"] >= 0.01
+        assert s["num_workers"] == 0
+
+    def test_async_mode_hides_assembly_behind_slow_consumer(self):
+        def make():
+            return {"valid": np.ones(2, bool)}
+
+        with Feeder(iter([make] * 6), num_workers=2, depth=4,
+                    put=False) as feed:
+            items = []
+            for item in feed:
+                time.sleep(0.01)  # consumer slower than assembly
+                items.append(item)
+        s = feed.stats()
+        assert s["batches"] == 6
+        # after the pipeline fills, batches are ready before the consumer
+        # asks: stall must be far below the consumer's own 10 ms cadence
+        assert s["feed_stall_s"] < 0.03
+        assert s["queue_depth_mean"] > 0
+
+    def test_device_put_leg_matches_host(self, corpus_split):
+        cfg, split = corpus_split
+        chunks = epoch_index_chunks(len(split), cfg, batch_size=8)[:2]
+        with Feeder(assembly_tasks(split, chunks, cfg, batch_size=8),
+                    num_workers=2) as feed:
+            for item in feed:
+                for k in item.host:
+                    np.testing.assert_array_equal(
+                        np.asarray(item.device[k]), item.host[k])

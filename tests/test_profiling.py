@@ -51,4 +51,30 @@ class TestMeter:
         assert s["p99_step_ms"] < 50
 
     def test_empty_summary(self):
-        assert profiling.Meter().summary()["steps"] == 0
+        s = profiling.Meter().summary()
+        assert s["steps"] == 0
+        assert s["feed_stall_frac"] == 0.0
+
+    def test_feed_stall_attribution(self):
+        m = profiling.Meter(warmup=0)
+        m.start()
+        time.sleep(0.02)
+        m.tick(1, stall_s=0.01)   # half the interval was feed stall
+        time.sleep(0.02)
+        m.tick(1)                 # none of this one was
+        s = m.summary()
+        assert 0.0 < s["feed_stall_frac"] < 1.0
+        assert s["feed_stall_ms_per_step"] >= 10 * 0.5 / 2
+        # a warmup interval's stall is discarded with its interval
+        m2 = profiling.Meter(warmup=1)
+        m2.start()
+        m2.tick(1, stall_s=5.0)
+        time.sleep(0.01)
+        m2.tick(1, stall_s=0.0)
+        assert m2.summary()["feed_stall_frac"] == 0.0
+
+    def test_feed_stall_frac_capped_at_one(self):
+        m = profiling.Meter(warmup=0)
+        m.start()
+        m.tick(1, stall_s=99.0)  # clock skew must not report frac > 1
+        assert m.summary()["feed_stall_frac"] == 1.0
