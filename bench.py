@@ -357,6 +357,22 @@ def worker() -> None:
     cfg, split, _ = make_memory_split(
         cfg, n_data, seed=0, pad_vocab_to=pad_vocab,
         pad_ast_vocab_to=71 if pad_vocab else 0)
+
+    # Padded-FLOP accounting rides along with every bench record (the
+    # bucket subsystem's motivating metric, docs/BUCKETING.md): how much of
+    # the single-geometry cost is pad multiplication on this corpus, and
+    # what the auto-chosen bucket table would leave. Measurement of the
+    # bucketed assembly/step path itself lives in scripts/bucket_bench.py.
+    try:
+        from fira_tpu.data import buckets as buckets_lib
+
+        pad_report = buckets_lib.padding_report(
+            split, cfg, buckets_lib.bucket_table(
+                cfg.replace(buckets=buckets_lib.choose_buckets(split, cfg))))
+    except Exception as e:  # accounting must never sink the measurement
+        print(f"padding report unavailable: {e!r}", file=sys.stderr)
+        pad_report = None
+
     rng = np.random.RandomState(0)
     # K>1 = the production device loop (one dispatch runs K steps via
     # lax.scan). The timed feeds rotate two K-stacked groups, so build 2*K
@@ -559,6 +575,11 @@ def worker() -> None:
         "feed_stall_frac": e2e_info["feed_stall_frac"],
         "feeder_queue_depth_mean": e2e_info["queue_depth_mean"],
         "feeder_workers": cfg.feeder_workers,
+        # padded-FLOP share of the single-geometry path on this corpus vs
+        # what the auto bucket table leaves (data/buckets.padding_report)
+        **({"padding_frac_single": pad_report["padding_frac_single"],
+            "padding_frac_bucketed": pad_report["padding_frac_bucketed"],
+            "bucket_report": pad_report["buckets"]} if pad_report else {}),
         "feed_stall_frac_sync_assembly": sync_info["feed_stall_frac"],
         "value_e2e_sync_assembly": round(
             batch_size / (dt_sync / steps_per_window) / n_chips, 2),
@@ -605,13 +626,26 @@ def _run_sub(mode: str, timeout_s: float,
         # the probe/worker child would survive as an orphan, holding the
         # driver-visible stdout pipe open and contending with the driver's
         # own next TPU client. PR_SET_PDEATHSIG (Linux) kills the child the
-        # instant its parent dies.
+        # instant its parent dies. "libc.so.6" is glibc's soname; musl and
+        # other libcs name it differently, so fall back to the loader's own
+        # lookup — and when neither installs the signal, say so on stderr
+        # (it lands in the attempt tail) so the orphan risk is visible
+        # instead of silent.
         try:
             import ctypes
+            import ctypes.util
             import signal as _sig
-            ctypes.CDLL("libc.so.6", use_errno=True).prctl(1, _sig.SIGKILL)
-        except Exception:
-            pass  # non-Linux fallback: orphan risk, but never block launch
+            try:
+                libc = ctypes.CDLL("libc.so.6", use_errno=True)
+            except OSError:
+                name = ctypes.util.find_library("c")
+                if name is None:
+                    raise OSError("no libc found via ctypes.util")
+                libc = ctypes.CDLL(name, use_errno=True)
+            libc.prctl(1, _sig.SIGKILL)  # PR_SET_PDEATHSIG
+        except Exception as e:  # never block the launch over this
+            print(f"PDEATHSIG not installed ({e!r}): child may orphan if "
+                  f"the orchestrator is killed", file=sys.stderr)
 
     try:
         p = subprocess.run(

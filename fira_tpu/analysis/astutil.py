@@ -36,16 +36,17 @@ _SCAN_CALLS = {
 _JIT_CALLS = {"jax.jit", "jit", "jax.pmap", "pmap", "pjit", "jax.pjit"}
 
 # The designated dispatch drivers whose for/while bodies are hot: the
-# train loop, the train-step factories, the decode drivers, and the async
+# train loop, the train-step factories, the decode drivers, the async
 # input pipeline (its dispatcher/worker/consumer loops run concurrently
 # with every step dispatch — a sync there stalls the feed exactly like one
-# in the train loop). NOT every train/decode module — e.g. decode/text.py
-# is host-only text cooking and train/state.py is checkpoint I/O (already
-# a boundary by definition).
+# in the train loop), and the bucket packer (its packing/assembly loops
+# run as feeder tasks on the same worker threads). NOT every train/decode
+# module — e.g. decode/text.py is host-only text cooking and
+# train/state.py is checkpoint I/O (already a boundary by definition).
 _DRIVER_FILES = (
     "fira_tpu/train/loop.py", "fira_tpu/train/step.py",
     "fira_tpu/decode/runner.py", "fira_tpu/decode/beam.py",
-    "fira_tpu/data/feeder.py",
+    "fira_tpu/data/feeder.py", "fira_tpu/data/buckets.py",
 )
 
 

@@ -66,12 +66,27 @@ class CompileWatcher(logging.Handler):
 
 @dataclasses.dataclass
 class CompileGuard:
-    """Per-program-label compile budget: 1 warmup dispatch, then zero."""
+    """Per-program-label compile budget: 1 warmup dispatch, then zero.
+
+    With a bucketed geometry family (data/buckets.py) every bucket's
+    program gets its own label (``train_step[a16.e256.t8]``): N programs
+    warm up, then still zero post-warmup compiles. Drivers additionally
+    :meth:`declare` the family after pre-warming — from then on a
+    dispatch under an UNDECLARED label raises, so a geometry outside the
+    declared bucket table (shape drift, a mis-packed batch) is caught at
+    the step that produced it, not as a mystery recompile."""
 
     watcher: CompileWatcher
     _last_count: int = 0
     _extra: int = 0
     _seen: Dict[str, int] = dataclasses.field(default_factory=dict)
+    _declared: Optional[set] = None
+
+    def declare(self, labels) -> None:
+        """Close the program family: after this, ``step()`` on a label not
+        in the (cumulative) declared set raises RetraceError. Idempotent
+        and additive — train and decode each declare their own labels."""
+        self._declared = (self._declared or set()) | set(labels)
 
     def step_counting(self, label: str) -> int:
         """Attribute compilations since the last call to ``label``'s
@@ -87,6 +102,12 @@ class CompileGuard:
 
     def step(self, label: str) -> None:
         """step_counting + raise: the drivers' per-dispatch check."""
+        if self._declared is not None and label not in self._declared:
+            raise RetraceError(
+                f"sanitizer: program '{label}' is not in the declared "
+                f"program family {sorted(self._declared)} — a geometry "
+                f"outside the declared bucket table reached a dispatch "
+                f"site (shape drift or a mis-packed batch)")
         extra = self.step_counting(label)
         if extra:
             recent = "; ".join(list(self.watcher.messages)[-min(extra, 5):])

@@ -125,6 +125,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="train: write a jax.profiler trace of a steady-state "
                         "step window here (TensorBoard-loadable)")
+    p.add_argument("--buckets", default=None, metavar="SPEC",
+                   help="padding-bucket family (docs/BUCKETING.md): 'off' "
+                        "(default — single geometry, byte-identical "
+                        "batches), 'auto' (choose 3 buckets from the "
+                        "split's length histograms), or an explicit table "
+                        "'AST:EDGES:TAR[,AST:EDGES:TAR...]' of geometries "
+                        "<= the config's full values. Each sample packs "
+                        "into its smallest admissible bucket; one "
+                        "pre-warmed program per bucket, zero post-warmup "
+                        "retraces. Requires fused/accum steps = 1")
     p.add_argument("--sanitize", action="store_true",
                    help="arm the runtime sanitizer (analysis.sanitizer): "
                         "jax_debug_nans/jax_debug_infs on every program, "
@@ -240,6 +250,31 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     dataset = FiraDataset(args.data_dir, cfg)
     cfg = dataset.cfg
+
+    # --buckets needs the processed split (auto reads its length
+    # histograms), so it resolves after the dataset, not in _resolve_cfg
+    if args.buckets and args.buckets != "off":
+        from fira_tpu.data import buckets as buckets_lib
+
+        split = dataset.splits["train" if args.command == "train" else "test"]
+        if args.buckets == "auto":
+            table = buckets_lib.choose_buckets(split, cfg)
+        else:
+            entries = []
+            for entry in args.buckets.split(","):
+                fields = entry.split(":")
+                if len(fields) != 3 or not all(
+                        f.strip().isdigit() for f in fields):
+                    print(f"--buckets entry {entry!r} is not "
+                          f"AST:EDGES:TAR (three integers); see "
+                          f"docs/BUCKETING.md", file=sys.stderr)
+                    return 2
+                entries.append(tuple(int(f) for f in fields))
+            table = tuple(entries)
+        cfg = cfg.replace(buckets=table)
+        print(f"buckets: {', '.join(f'{a}:{e}:{t}' for a, e, t in table)} "
+              f"(+ full fallback)")
+
     var_maps = _load_var_maps(args.data_dir)
     suffix = f"_{args.ablation}" if args.ablation else ""
     ckpt_dir = args.ckpt_dir or os.path.join(args.out_dir, f"ckpt{suffix}")

@@ -193,6 +193,21 @@ class FiraConfig:
     # of the step dispatch.
     feeder_depth: int = 4
 
+    # --- bucketed padding geometry (data/buckets.py; docs/BUCKETING.md) ---
+    # Declared family of smaller padding geometries, each entry
+    # (ast_change_len, max_edges, tar_len) <= the full values above; the
+    # full geometry is always the implicit fallback bucket. The packer
+    # assigns every sample to its smallest admissible bucket and groups
+    # same-bucket samples into batches, so XLA compiles |buckets|+1
+    # programs per entry point — all pre-warmed at startup, zero
+    # post-warmup retraces (the sanitizer learns the declared family).
+    # () = off: the single-geometry path, byte-identical batches.
+    # sou_len/sub_token_len are NOT bucketable (the copy-label id space
+    # and fused output width bake them in). Composes with per-step
+    # dispatch only: fused_steps/accum_steps > 1 raises. The CLI's
+    # --buckets auto fills this from the corpus length histograms.
+    buckets: tuple = ()
+
     # --- long context ---
     # >1 routes decoder cross-attention through ring attention
     # (parallel/ring.py) over a (data, seq) mesh with that many sequence

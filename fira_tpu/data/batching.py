@@ -1,10 +1,13 @@
 """Fixed-shape batch assembly for jitted TPU programs.
 
-Every batch has identical shapes (XLA compiles once): the final partial batch
-of an epoch is padded with zeroed samples whose labels are all <pad>, so they
-contribute nothing to the masked loss; a ``valid`` bool array marks real rows
-for eval bookkeeping. COO edges are padded per-sample to cfg.max_edges
-(pad entries scatter zero — a no-op on device).
+Every batch has a shape drawn from a SMALL FIXED FAMILY (XLA compiles once
+per family member): by default the single full config geometry; under
+``cfg.buckets`` (data/buckets.py, docs/BUCKETING.md) one of a declared set
+of smaller padding geometries via ``make_batch(..., geom=...)``. The final
+partial batch of an epoch is padded with zeroed samples whose labels are
+all <pad>, so they contribute nothing to the masked loss; a ``valid`` bool
+array marks real rows for eval bookkeeping. COO edges are padded per-sample
+to cfg.max_edges (pad entries scatter zero — a no-op on device).
 
 The reference instead ships a dense 650^2 float adjacency per sample through
 a torch DataLoader (Dataset.py:336-343) — the batching fix called out in
@@ -41,10 +44,15 @@ def sort_edge_rows(senders, receivers, values, kinds, graph_len: int):
 
 
 def _gather_edges_loop(split: ProcessedSplit, indices: np.ndarray,
-                       cfg: FiraConfig, bs: int):
+                       cfg: FiraConfig, bs: int, drop: int = 0):
     """Pre-refactor per-row edge gather — the GOLDEN REFERENCE the
     vectorized path is pinned bit-exact against (tests/
-    test_batching_golden.py). Not called on any hot path."""
+    test_batching_golden.py). Not called on any hot path.
+
+    ``drop``: shorten every sample's ragged slice by this many TRAILING
+    entries — under a bucketed geometry (data/buckets.py) the truncated
+    pad nodes' self-loops sit exactly there (build_adjacency appends one
+    self-loop per full-geometry node, ascending, after all family edges)."""
     senders = np.zeros((bs, cfg.max_edges), dtype=np.int16)
     receivers = np.zeros((bs, cfg.max_edges), dtype=np.int16)
     values = np.zeros((bs, cfg.max_edges), dtype=np.float32)
@@ -54,8 +62,12 @@ def _gather_edges_loop(split: ProcessedSplit, indices: np.ndarray,
              if cfg.typed_edges else None)
     offsets = split.arrays["edge_offsets"]
     for row, i in enumerate(indices):
-        lo, hi = offsets[i], offsets[i + 1]
+        lo, hi = offsets[i], offsets[i + 1] - drop
         n = hi - lo
+        if n < 0:
+            raise ValueError(
+                f"sample {i}: {offsets[i + 1] - offsets[i]} edges < "
+                f"geometry drop {drop} — not a self-looped adjacency")
         if n > cfg.max_edges:
             raise ValueError(f"sample {i}: {n} edges > max_edges={cfg.max_edges}")
         senders[row, :n] = split.arrays["edge_senders"][lo:hi]
@@ -82,10 +94,12 @@ _VEC_EDGE_CROSSOVER = 64
 
 
 def _gather_edges_vectorized(split: ProcessedSplit, indices: np.ndarray,
-                             cfg: FiraConfig, bs: int):
+                             cfg: FiraConfig, bs: int, drop: int = 0):
     """Vectorized COO gather, bit-exact vs ``_gather_edges_loop``
     (identical destination arrays, identical source element order,
     identical dtype narrowing on assignment; pinned by the golden test).
+    ``drop``: trailing pad-node self-loops to shed per sample — see the
+    loop reference's docstring.
 
     Addressing (offsets, counts, the overflow check) is always vectorized.
     The copies pick a regime by mean edges per row (see
@@ -96,7 +110,12 @@ def _gather_edges_vectorized(split: ProcessedSplit, indices: np.ndarray,
     idx = np.asarray(indices, dtype=np.intp)
     offsets = split.arrays["edge_offsets"]
     lo = offsets[idx]
-    counts = (offsets[idx + 1] - lo).astype(np.intp)
+    counts = (offsets[idx + 1] - lo - drop).astype(np.intp)
+    if counts.size and counts.min() < 0:
+        row = int(np.argmax(counts < 0))
+        raise ValueError(
+            f"sample {idx[row]}: {counts[row] + drop} edges < geometry "
+            f"drop {drop} — not a self-looped adjacency")
     if counts.size and counts.max() > cfg.max_edges:
         row = int(np.argmax(counts > cfg.max_edges))  # first offender, like the loop
         raise ValueError(
@@ -145,19 +164,52 @@ def _gather_edges_vectorized(split: ProcessedSplit, indices: np.ndarray,
 
 def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
                batch_size: Optional[int] = None, *,
-               edge_gather: str = "vectorized") -> Batch:
+               edge_gather: str = "vectorized",
+               geom=None) -> Batch:
     """Gather + pad a batch. ``indices`` may be shorter than batch_size.
 
     ``edge_gather``: "vectorized" (default, the flat cumsum/np.repeat COO
     gather) or "loop" (the pre-refactor per-row reference — kept only so
-    the golden test can pin bit-exactness through the full batch path)."""
+    the golden test can pin bit-exactness through the full batch path).
+
+    ``geom``: an optional ``data.buckets.BucketGeom`` — pad to THAT
+    geometry instead of the config's full one: the ast_change node tail,
+    msg/msg_tar positions, and the COO pad shrink to the bucket's
+    (ast_len, max_edges, tar_len); the truncated pad nodes' self-loop
+    edges (the trailing ``graph_len - bucket_graph_len`` entries of each
+    ragged slice) are dropped with them. Exact for every real value —
+    pinned by tests/test_buckets.py. A sample that does not FIT the
+    geometry (nonzero data in a truncated region, an edge into a truncated
+    node) raises loudly: the packer owns admissibility, this function
+    enforces it."""
+    drop = 0
+    if geom is not None:
+        from fira_tpu.data.buckets import BucketGeom, _validated
+
+        g = _validated(cfg, BucketGeom(*geom))
+        # the truncated pad nodes' self-loops are the ragged tail to shed
+        drop = cfg.ast_change_len - g.ast_len
+        cfg = cfg.replace(ast_change_len=g.ast_len, max_edges=g.max_edges,
+                          tar_len=g.tar_len)
     bs = batch_size or len(indices)
     n_real = len(indices)
     if n_real > bs:
         raise ValueError(f"{n_real} indices exceed batch_size={bs}")
+    # per-field bucketed width (None = full width stays)
+    widths = ({"ast_change": cfg.ast_change_len, "msg": cfg.tar_len,
+               "msg_tar": cfg.tar_len} if geom is not None else {})
     batch: Batch = {}
     for f in ARRAY_FIELDS:
         src = split.arrays[f][indices]
+        w = widths.get(f)
+        if w is not None and w < src.shape[1]:
+            tail = src[:, w:]
+            if tail.any():
+                row = int(np.argmax(tail.any(axis=1)))
+                raise ValueError(
+                    f"sample {indices[row]}: nonzero {f!r} data beyond "
+                    f"bucket width {w} — sample does not fit the geometry")
+            src = src[:, :w]
         if n_real < bs:
             pad = np.zeros((bs - n_real,) + src.shape[1:], dtype=src.dtype)
             src = np.concatenate([src, pad])
@@ -202,7 +254,15 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
             f"(max index {np.iinfo(np.int16).max}); widen the edge dtype")
     gather = {"vectorized": _gather_edges_vectorized,
               "loop": _gather_edges_loop}[edge_gather]
-    senders, receivers, values, kinds = gather(split, indices, cfg, bs)
+    senders, receivers, values, kinds = gather(split, indices, cfg, bs, drop)
+    if geom is not None and len(indices):
+        # admissibility backstop: an edge into a truncated node would
+        # scatter out of the bucket's adjacency — silently wrong on TPU
+        hi = max(int(senders.max()), int(receivers.max()))
+        if hi >= cfg.graph_len:
+            raise ValueError(
+                f"edge references node {hi} >= bucketed graph_len "
+                f"{cfg.graph_len} — sample does not fit the geometry")
     if cfg.sort_edges:
         senders, receivers, values, kinds = sort_edge_rows(
             senders, receivers, values, kinds, cfg.graph_len)
@@ -236,6 +296,21 @@ def make_batch(split: ProcessedSplit, indices: np.ndarray, cfg: FiraConfig,
     return batch
 
 
+def epoch_order(n: int, *, shuffle: bool = False, seed: int = 0,
+                epoch: int = 0) -> np.ndarray:
+    """The deterministic sample PERMUTATION of an epoch — the single
+    source every packing strategy chunks from: ``epoch_index_chunks``
+    slices it into fixed-size chunks, the bucket packer
+    (data/buckets.packed_plan) walks the SAME permutation grouping by
+    bucket. Seed and epoch fold together so each epoch draws a fresh but
+    fully reproducible permutation (the reference's DataLoader
+    shuffle=True, run_model.py:387)."""
+    order = np.arange(n)
+    if shuffle:
+        np.random.RandomState((seed * 1_000_003 + epoch) % (2**31)).shuffle(order)
+    return order
+
+
 def epoch_index_chunks(n: int, cfg: FiraConfig, *,
                        batch_size: Optional[int] = None,
                        shuffle: bool = False,
@@ -243,16 +318,12 @@ def epoch_index_chunks(n: int, cfg: FiraConfig, *,
                        epoch: int = 0,
                        drop_remainder: bool = False) -> List[np.ndarray]:
     """The deterministic batch ORDER of an epoch, as a list of index chunks
-    (shuffled like the reference's DataLoader(shuffle=True),
-    run_model.py:387; seed and epoch fold together so each epoch draws a
-    fresh but fully reproducible permutation). This is the single source of
-    truth for batch order: ``epoch_batches`` assembles these chunks inline,
-    the async Feeder (data/feeder.py) assembles the SAME chunks on worker
-    threads — byte-identical sequences either way."""
+    (see ``epoch_order`` for the permutation contract). This is the single
+    source of truth for batch order: ``epoch_batches`` assembles these
+    chunks inline, the async Feeder (data/feeder.py) assembles the SAME
+    chunks on worker threads — byte-identical sequences either way."""
     bs = batch_size or cfg.batch_size
-    order = np.arange(n)
-    if shuffle:
-        np.random.RandomState((seed * 1_000_003 + epoch) % (2**31)).shuffle(order)
+    order = epoch_order(n, shuffle=shuffle, seed=seed, epoch=epoch)
     chunks = [order[start : start + bs] for start in range(0, n, bs)]
     if drop_remainder and chunks and len(chunks[-1]) < bs:
         chunks.pop()

@@ -62,8 +62,10 @@ class FedBatch:
     """One emitted pipeline item."""
 
     index: int          # position in the deterministic batch order
-    host: Batch         # the assembled numpy batch (for host-side fields)
-    device: Any         # jax.device_put result (== host when put=False)
+    host: Batch         # the assembled numpy batch (for host-side fields,
+                        # incl. "_"-prefixed host-only metadata)
+    device: Any         # jax.device_put result, "_" keys stripped
+                        # (== host when put=False)
     n_valid: int        # real (non-pad) rows, computed pre-transfer
     stall_s: float      # consumer time blocked waiting for THIS item
     queue_depth: int    # ready-but-unconsumed items when consumer arrived
@@ -170,8 +172,14 @@ class Feeder:
             return host
         import jax
 
-        sh = self._sharding(host) if callable(self._sharding) else self._sharding
-        return jax.device_put(host, sh) if sh is not None else jax.device_put(host)
+        # keys starting with "_" are HOST-ONLY metadata (bucket packer
+        # positions/tags, data/buckets.py): they never ship to the device
+        # and never reach the sharding callable — the wire pytree keeps the
+        # exact structure the jitted programs were traced with
+        wire = ({k: v for k, v in host.items() if not k.startswith("_")}
+                if isinstance(host, dict) else host)
+        sh = self._sharding(wire) if callable(self._sharding) else self._sharding
+        return jax.device_put(wire, sh) if sh is not None else jax.device_put(wire)
 
     def _poison(self, e: BaseException) -> None:
         with self._cond:

@@ -14,6 +14,7 @@ import jax
 import numpy as np
 
 from fira_tpu.config import FiraConfig
+from fira_tpu.data import buckets as buckets_lib
 from fira_tpu.data.batching import epoch_index_chunks
 from fira_tpu.data.dataset import FiraDataset
 from fira_tpu.data.feeder import Feeder, assembly_tasks
@@ -47,21 +48,56 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
     indices = dataset.split_indices[split]
     beam = make_beam_search(model, cfg)
 
+    # Bucketed decode (data/buckets.py): sort-by-length packing over the
+    # (ast nodes, edges) axes — tar_len stays FULL on every decode bucket,
+    # the model decides the output length and it must not be clipped. Each
+    # bucket's beam program is pre-warmed here with an all-pad batch, then
+    # the guard learns the closed family. The packer reorders the sample
+    # stream, so output lines buffer and write in split order at the end
+    # (the buckets-off path keeps its crash-resilient streaming writes).
+    table = None
+    if cfg.buckets:
+        table = buckets_lib.decode_table(cfg)
+        if guard is not None:
+            guard.declare(f"beam_search[{buckets_lib.geom_tag(g)}]"
+                          for g in table)
+        for g in table:
+            beam(params, buckets_lib.warmup_batch(data, cfg, g,
+                                                  cfg.test_batch_size))
+            if guard is not None:
+                guard.step(f"beam_search[{buckets_lib.geom_tag(g)}]")
+        plan = buckets_lib.packed_plan(data, cfg,
+                                       batch_size=cfg.test_batch_size,
+                                       table=table, use_msg=False)
+        tasks = buckets_lib.bucketed_assembly_tasks(
+            data, plan, cfg, batch_size=cfg.test_batch_size)
+        print(f"decode buckets: {len(table)} beam programs pre-warmed "
+              f"({', '.join(buckets_lib.geom_tag(g) for g in table)})",
+              flush=True)
+    else:
+        chunks = epoch_index_chunks(len(data), cfg,
+                                    batch_size=cfg.test_batch_size)
+        tasks = assembly_tasks(data, chunks, cfg,
+                               batch_size=cfg.test_batch_size)
+
     os.makedirs(out_dir, exist_ok=True)
     out_path = os.path.join(out_dir, output_name(ablation))
     # stream to a .partial file, atomically renamed on completion: full-size
-    # decodes run for tens of minutes and a crash must not cost every line
+    # decodes run for tens of minutes and a crash must not cost every line.
+    # Bucketed packing emits samples out of split order, so its .partial
+    # lines stream POSITION-TAGGED ("pos\tline" — still crash-recoverable,
+    # every decoded line is on disk the moment its batch lands) and the
+    # plain split-ordered final file is written from the sorted buffer at
+    # completion; the buckets-off path keeps the historical plain stream.
     partial_path = out_path + ".partial"
     total_bleu, n = 0.0, 0
     cursor = 0
     n_total = len(data)
-    chunks = epoch_index_chunks(len(data), cfg, batch_size=cfg.test_batch_size)
+    buffered: List[tuple] = []  # bucketed mode: (split position, line)
     # the Feeder is constructed INSIDE the with (after open succeeds): a
     # failing open must not leak already-started worker threads
     with open(partial_path, "w") as out_f, \
-            Feeder(assembly_tasks(data, chunks, cfg,
-                                  batch_size=cfg.test_batch_size),
-                   num_workers=cfg.feeder_workers,
+            Feeder(tasks, num_workers=cfg.feeder_workers,
                    depth=cfg.feeder_depth) as feed:
         for item in feed:
             batch = item.host  # numpy fields for host-side text cooking
@@ -69,8 +105,10 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
             # firacheck: allow[HOST-SYNC] per-batch output collection IS the decode boundary: beams must reach the host to be cooked into text
             tokens = np.asarray(jax.device_get(tokens))
             probs = np.asarray(jax.device_get(probs))  # firacheck: allow[HOST-SYNC] same decode output boundary as the line above
+            positions = batch.get("_positions")  # bucketed stream only
             if guard is not None:
-                guard.step("beam_search")
+                tag = batch.get("_tag")
+                guard.step(f"beam_search[{tag}]" if tag else "beam_search")
             valid = batch["valid"]  # host-side numpy batch field, no sync
             for i in range(tokens.shape[0]):
                 if not valid[i]:
@@ -84,13 +122,30 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
                 ref = reference_words(batch["msg"][i], vocab)
                 total_bleu += nltk_sentence_bleu([ref], hyp)
                 n += 1
-                var_map = (var_maps[indices[cursor]]
+                pos = cursor if positions is None else int(positions[i])  # firacheck: allow[HOST-SYNC] _positions is a host-only numpy field (feeder strips it from the wire); no device value exists here
+                var_map = (var_maps[indices[pos]]
                            if var_maps is not None else None)
-                out_f.write(" ".join(deanonymize(hyp, var_map)) + "\n")
+                line = " ".join(deanonymize(hyp, var_map)) + "\n"
+                if positions is None:
+                    out_f.write(line)
+                else:
+                    out_f.write(f"{pos}\t{line}")  # tagged, crash-recoverable
+                    buffered.append((pos, line))
                 cursor += 1
             if n and n % 1000 < cfg.test_batch_size:
                 out_f.flush()
                 print(f"decode: {n}/{n_total}", flush=True)
-    os.replace(partial_path, out_path)
+    if buffered:
+        # completion: the split-ordered plain file replaces the tagged
+        # stream atomically (write-then-rename, like the plain path)
+        buffered.sort(key=lambda r: r[0])
+        ordered_path = out_path + ".ordered"
+        with open(ordered_path, "w") as f:
+            for _, line in buffered:
+                f.write(line)
+        os.replace(ordered_path, out_path)
+        os.remove(partial_path)
+    else:
+        os.replace(partial_path, out_path)
     return {"sentence_bleu": total_bleu / max(n, 1), "n": float(n),
             "output_path": out_path}  # type: ignore[return-value]

@@ -291,10 +291,14 @@ class Attention(nn.Module):
                 # the triangle below assumes queries start at key position 0;
                 # a cached/offset decode step (q_len=1, kv_len=T) would get a
                 # mask attending only key 0 — fail loudly on that misuse
-                # (offset decode goes through the KV-cache path instead)
-                assert q_len == k.shape[2], (
-                    f"causal=True requires q_len == kv_len (got {q_len} vs "
-                    f"{k.shape[2]}); offset decode must use the cache path")
+                # (offset decode goes through the KV-cache path instead).
+                # Shapes are static so this costs nothing at trace time; a
+                # bare assert would vanish under `python -O`
+                if q_len != k.shape[2]:
+                    raise ValueError(
+                        f"causal=True requires q_len == kv_len (got {q_len} "
+                        f"vs {k.shape[2]}); offset decode must use the "
+                        f"cache path")
                 tri = jnp.tril(jnp.ones((q_len, k.shape[2]), dtype=bool))
                 weight = jnp.where(tri[None, None],
                                    weight, jnp.asarray(-1e9, weight.dtype))

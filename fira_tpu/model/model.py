@@ -426,6 +426,12 @@ class FiraModel(nn.Module):
         """Run the graph encoder once; returns ([diff||sub] states, mask)."""
         cfg = self.cfg
         batch = dict(batch)
+        # node count from the BATCH, not the config: equals cfg.graph_len at
+        # full pad, smaller under a bucketed geometry (data/buckets.py) whose
+        # ast_change tail was truncated — the diff/sub regions are pinned by
+        # the copy-label id space and never shrink
+        graph_len = (batch["diff"].shape[1] + batch["sub_token"].shape[1]
+                     + batch["ast_change"].shape[1])
         if cfg.typed_edges:
             # typed-edge extension: per-family learned gain on the normalized
             # weights; at init (all ones) this is bit-identical to the
@@ -448,7 +454,7 @@ class FiraModel(nn.Module):
             # never materializing the f32 (B, N, N) buffer at all
             adj = dense_adjacency(
                 batch["senders"], batch["receivers"], batch["values"],
-                cfg.graph_len, indices_sorted=cfg.sort_edges,
+                graph_len, indices_sorted=cfg.sort_edges,
                 out_dtype=self.dtype, flat=cfg.flat_scatter,
             )
         else:

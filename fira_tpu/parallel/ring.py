@@ -28,8 +28,20 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6 exports shard_map at top level (check_vma keyword)
+    from jax import shard_map as _shard_map
+
+    def _sharded(body, mesh, in_specs, out_specs):
+        return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+except ImportError:  # jax 0.4.x: experimental module, check_rep keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _sharded(body, mesh, in_specs, out_specs):
+        return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
 
 SEQ_AXIS = "seq"
 NEG_INF = -1e9
@@ -135,11 +147,10 @@ def ring_attention_sharded(q, k, v, kv_mask, mesh: Mesh, *,
     mask_spec = P(batch_axis, seq_axis)
     body = functools.partial(ring_attention, causal=causal,
                              axis_name=seq_axis)
-    fn = shard_map(
-        body, mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-        out_specs=qkv_spec,
-        check_vma=False,
+    fn = _sharded(
+        body, mesh,
+        (qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        qkv_spec,
     )
     return fn(q, k, v, kv_mask)
 

@@ -28,6 +28,7 @@ import jax
 import numpy as np
 
 from fira_tpu.config import FiraConfig
+from fira_tpu.data import buckets as buckets_lib
 from fira_tpu.data.batching import epoch_index_chunks, make_batch
 from fira_tpu.data.dataset import FiraDataset
 from fira_tpu.data.feeder import Feeder, assembly_tasks
@@ -63,20 +64,40 @@ class TrainLog:
         print(msg, flush=True)
 
 
+def _eval_tasks(data, cfg: FiraConfig):
+    """Assembly tasks for the dev pass: the single-geometry sequential
+    chunks when buckets are off (the byte-identical legacy stream), the
+    bucketed sort-by-length plan when on. Dev packs with the DECODE bucket
+    table — tar_len pinned full, admissibility on (nodes, edges) only:
+    the reference's gating metric scores teacher-forced predictions at
+    EVERY tar position (even pad-conditioned ones, run_model.py:118-184),
+    so truncating tar would change the metric; with tar full the per-line
+    dev output is bit-identical to the unbucketed pass (pinned by
+    tests/test_buckets.py)."""
+    if cfg.buckets:
+        table = buckets_lib.decode_table(cfg)
+        plan = buckets_lib.packed_plan(data, cfg,
+                                       batch_size=cfg.test_batch_size,
+                                       table=table, use_msg=False)
+        return buckets_lib.bucketed_assembly_tasks(
+            data, plan, cfg, batch_size=cfg.test_batch_size)
+    chunks = epoch_index_chunks(len(data), cfg, batch_size=cfg.test_batch_size)
+    return assembly_tasks(data, chunks, cfg, batch_size=cfg.test_batch_size)
+
+
 def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
             var_maps: Optional[List[Dict[str, str]]] = None,
             split: str = "valid", guard=None) -> tuple[float, str]:
     """Greedy teacher-forced validation (run_model.py:118-184). Returns
-    (mean sentence BLEU over the split, dev_output text)."""
+    (mean sentence BLEU over the split, dev_output text — always in split
+    order, even when the bucket packer reordered the batch stream)."""
     data = dataset.splits[split]
     vocab = dataset.word_vocab
     indices = dataset.split_indices[split]
     total_bleu = 0.0
-    out_lines = []
+    out_lines: List[tuple] = []  # (split position, line)
     cursor = 0
-    chunks = epoch_index_chunks(len(data), cfg, batch_size=cfg.test_batch_size)
-    with Feeder(assembly_tasks(data, chunks, cfg,
-                               batch_size=cfg.test_batch_size),
+    with Feeder(_eval_tasks(data, cfg),
                 num_workers=cfg.feeder_workers,
                 depth=cfg.feeder_depth) as feed:
         for item in feed:
@@ -84,11 +105,14 @@ def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
             # firacheck: allow[HOST-SYNC] dev gate IS a designated sync boundary: teacher-forced ids must reach the host for BLEU scoring (README Design notes)
             ids = np.asarray(jax.device_get(dev_step(params, item.device)))
             valid = batch["valid"]  # host-side numpy batch field, no device trip
+            positions = batch.get("_positions")  # bucketed stream only
             if guard is not None:
-                guard.step("dev_step")
+                tag = batch.get("_tag")
+                guard.step(f"dev_step[{tag}]" if tag else "dev_step")
             for i in range(ids.shape[0]):
                 if not valid[i]:
                     continue
+                pos = cursor if positions is None else int(positions[i])  # firacheck: allow[HOST-SYNC] _positions is a host-only numpy field (feeder strips it from the wire); no device value exists here
                 hyp = cook_prediction(
                     ids[i].tolist(), batch["diff"][i], batch["sub_token"][i],
                     vocab, cfg,
@@ -96,11 +120,14 @@ def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
                 ref = reference_words(batch["msg"][i], vocab)
                 b = nltk_sentence_bleu([ref], hyp)
                 total_bleu += b
-                var_map = (var_maps[indices[cursor]]
+                var_map = (var_maps[indices[pos]]
                            if var_maps is not None else None)
-                out_lines.append(" ".join(deanonymize(hyp, var_map)) + f",{b}")
+                out_lines.append(
+                    (pos, " ".join(deanonymize(hyp, var_map)) + f",{b}"))
                 cursor += 1
-    return total_bleu / max(len(data), 1), "\n".join(out_lines) + "\n"
+    out_lines.sort(key=lambda r: r[0])
+    return (total_bleu / max(len(data), 1),
+            "\n".join(line for _, line in out_lines) + "\n")
 
 
 def _materialize(x) -> None:
@@ -239,11 +266,71 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                  else step_lib.jit_accum_step)
         grouped_step = maker(model, cfg, mesh, state, stacked_sample)
 
+    # --- bucketed geometry family (data/buckets.py; docs/BUCKETING.md) ---
+    # Table + per-sample assignment computed ONCE for the train split; the
+    # whole program family is pre-warmed here — each bucket's train/dev
+    # program compiles against a throwaway state copy and an all-pad batch
+    # (zero training effect), so the epoch loop never compiles again. The
+    # guard then learns the closed family: every bucket label gets its one
+    # warmup dispatch, and any label outside the declared set raises.
+    bucket_table = bucket_assignment = None
+    if cfg.buckets:
+        if group_size > 1:
+            raise ValueError(
+                "buckets compose with per-step dispatch only: set "
+                "fused_steps/accum_steps to 1 (stacked groups would need "
+                "same-bucket grouping, which the packer does not do)")
+        bucket_table = buckets_lib.bucket_table(cfg)
+        bucket_assignment = buckets_lib.assign_buckets(
+            buckets_lib.sample_extents(train_split, cfg), bucket_table)
+        # dev packs with the decode table (tar pinned full — the gating
+        # metric scores every tar position, see _eval_tasks)
+        dev_geoms = buckets_lib.decode_table(cfg)
+        labels = ([f"train_step[{buckets_lib.geom_tag(g)}]"
+                   for g in bucket_table]
+                  + [f"dev_step[{buckets_lib.geom_tag(g)}]"
+                     for g in dev_geoms])
+        if guard is not None:
+            guard.declare(labels)
+        # donation-safe throwaway copy: the real state (and its PRNG) is
+        # untouched by warmup; host round-trip avoids compiling a copy op
+        host_state = jax.device_get(state)
+        warm_state = (jax.device_put(host_state,
+                                     step_lib.state_shardings(state, mesh))
+                      if mesh is not None else jax.device_put(host_state))
+        for g in bucket_table:
+            wb = buckets_lib.warmup_batch(train_split, cfg, g,
+                                          cfg.batch_size)
+            warm_state, wm = train_step(warm_state, wb)
+            if guard is not None:
+                guard.step(f"train_step[{buckets_lib.geom_tag(g)}]")
+        for g in dev_geoms:
+            wb = buckets_lib.warmup_batch(train_split, cfg, g,
+                                          cfg.test_batch_size)
+            dev_step(state.params, wb)
+            if guard is not None:
+                guard.step(f"dev_step[{buckets_lib.geom_tag(g)}]")
+        _materialize(wm["loss"])  # startup warmup boundary, pre-metering
+        del warm_state, host_state
+        log.console(f"buckets: pre-warmed {len(bucket_table)} train + "
+                    f"{len(dev_geoms)} dev programs "
+                    f"({', '.join(buckets_lib.geom_tag(g) for g in bucket_table)})")
+        meter.start()  # warmup/compile time is not train time
+
     def epoch_tasks(epoch: int):
         """Zero-arg assembly tasks in the exact deterministic (seed, epoch)
-        batch order: stacked groups then un-stacked tail batches. Each task
-        builds ONE dispatch item, so independent items assemble in parallel
-        on the feeder's workers."""
+        batch order: stacked groups then un-stacked tail batches (or the
+        bucket packer's greedy walk over the SAME permutation when
+        cfg.buckets is on). Each task builds ONE dispatch item, so
+        independent items assemble in parallel on the feeder's workers."""
+        if bucket_table is not None:
+            plan = buckets_lib.packed_plan(
+                train_split, cfg, batch_size=cfg.batch_size, shuffle=True,
+                seed=cfg.seed, epoch=epoch, table=bucket_table,
+                assignment=bucket_assignment)
+            yield from buckets_lib.bucketed_assembly_tasks(
+                train_split, plan, cfg, batch_size=cfg.batch_size)
+            return
         chunks = epoch_index_chunks(len(train_split), cfg, shuffle=True,
                                     seed=cfg.seed, epoch=epoch)
         if group_size == 1:
@@ -333,9 +420,14 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                 else:
                     state, metrics = train_step(state, batch)
                 if guard is not None:
-                    # compile-once contract: a post-warmup dispatch of either
-                    # program that recompiles raises RetraceError here
-                    guard.step("grouped_step" if stacked else "train_step")
+                    # compile-once contract: a post-warmup dispatch of any
+                    # program that recompiles raises RetraceError here; a
+                    # bucketed item carries its geometry tag, giving each
+                    # bucket's pre-warmed program its own label
+                    tag = item.host.get("_tag")
+                    guard.step(f"train_step[{tag}]" if tag
+                               else ("grouped_step" if stacked
+                                     else "train_step"))
                 # a fused group is k steps; an accumulation group is ONE step
                 global_step += 1 if (stacked and accum > 1) else k
                 last_metrics = metrics

@@ -8,12 +8,14 @@ set -u
 cd "$(dirname "$0")/.."
 
 echo "== firacheck: static JAX-hazard scan =="
-# fira_tpu/data/feeder.py is named explicitly (as well as being inside the
-# fira_tpu tree, which the CLI dedupes): the async input pipeline is a
-# designated driver module (astutil._DRIVER_FILES) whose threaded loops
-# MUST stay in the self-scan even if the directory arguments ever change.
+# fira_tpu/data/feeder.py and fira_tpu/data/buckets.py are named
+# explicitly (as well as being inside the fira_tpu tree, which the CLI
+# dedupes): the async input pipeline and the bucket packer are designated
+# driver modules (astutil._DRIVER_FILES) whose threaded/packing loops MUST
+# stay in the self-scan even if the directory arguments ever change.
 JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
-    fira_tpu fira_tpu/data/feeder.py tests scripts || exit $?
+    fira_tpu fira_tpu/data/feeder.py fira_tpu/data/buckets.py tests scripts \
+    || exit $?
 
 echo "== tier-1 pytest (ROADMAP.md verify, verbatim) =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,332 @@
+"""Bucket subsystem tests (data/buckets.py; docs/BUCKETING.md).
+
+The two contracts the whole design rests on, pinned:
+
+1. BIT-EXACTNESS — a sample batched at its bucket geometry produces the
+   IDENTICAL per-sample loss (deterministic forward, float equality) and
+   IDENTICAL decoded tokens as the same sample at full-pad geometry,
+   through every adjacency path (dense, segment/COO, sorted, bf16 wire,
+   typed edges). Truncated pad is exact zeros: pad ast nodes carry only
+   their own self-loop (dropped with them), pad edges scatter zero, pad
+   tar positions are masked out of the loss.
+2. COMPILE DISCIPLINE — exactly |table| programs per entry point after the
+   startup warmup pass, ZERO new compilations after (the PR-1 invariant,
+   now over a program family), and the sanitizer's declared-family check
+   catches a geometry outside the table at the dispatch that produced it.
+
+Plus the packer's determinism/coverage/admissibility contract and the
+end-to-end drivers (train / run_dev / run_test) with buckets on.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fira_tpu.analysis import sanitizer
+from fira_tpu.config import fira_tiny
+from fira_tpu.data import buckets as B
+from fira_tpu.data.batching import epoch_index_chunks, make_batch
+from fira_tpu.data.feeder import Feeder
+from fira_tpu.data.synthetic import make_memory_split
+from fira_tpu.decode.beam import beam_search_cached
+from fira_tpu.model.model import FiraModel
+from fira_tpu.train import step as step_lib
+from fira_tpu.train.state import init_state
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    cfg, split, _ = make_memory_split(fira_tiny(), 48, seed=11)
+    return cfg, split
+
+
+@pytest.fixture(scope="module")
+def extents(corpus):
+    cfg, split = corpus
+    return B.sample_extents(split, cfg)
+
+
+# a geometry the synthetic corpus comfortably fits (ast extents are <= 9,
+# msg extents <= 8, edge counts <= ~170 of which 16 are truncated-tail
+# self-loops at ast_len 16)
+GEOM = B.BucketGeom(16, 256, 8)
+
+
+# (name, overrides, decode_too) — decode-token equality is pinned on the
+# four adjacency/wire paths the acceptance contract names (dense,
+# COO/segment, sorted scatter, bf16 wire); typed_edges additionally pins
+# the edge_kinds field through the bucketed gather on the loss side (its
+# beam behavior adds no geometry coverage beyond the other variants, and
+# each beam jit costs ~10 s of tier-1 budget)
+VARIANTS = [
+    ("dense", {}, True),
+    ("sorted", {"sort_edges": True}, True),
+    ("segment", {"adjacency_impl": "segment"}, True),
+    ("bf16_wire", {"compute_dtype": "bfloat16", "sort_edges": True}, True),
+    ("typed_edges", {"typed_edges": True, "sort_edges": True}, False),
+]
+
+
+@pytest.mark.parametrize("overrides,decode_too",
+                         [(v[1], v[2]) for v in VARIANTS],
+                         ids=[v[0] for v in VARIANTS])
+def test_bucket_geometry_bit_exact_loss_and_decode(corpus, extents,
+                                                   overrides, decode_too):
+    cfg0, split = corpus
+    cfg = cfg0.replace(**overrides)
+    idx = np.where(extents.admissible(GEOM))[0][:4]
+    assert len(idx) == 4, "fixture corpus must fit the test bucket"
+
+    model = FiraModel(cfg, dtype=jnp.dtype(cfg.compute_dtype))
+    full = make_batch(split, idx, cfg, batch_size=4)
+    bucket = make_batch(split, idx, cfg, batch_size=4, geom=GEOM)
+    params = init_state(model, cfg, full).params
+
+    # per-sample deterministic loss: float-equal, not allclose
+    for i in range(2):
+        nll_f, cnt_f = model.apply(
+            {"params": params}, {k: v[i : i + 1] for k, v in full.items()},
+            deterministic=True)
+        nll_b, cnt_b = model.apply(
+            {"params": params}, {k: v[i : i + 1] for k, v in bucket.items()},
+            deterministic=True)
+        assert float(cnt_f) == float(cnt_b)
+        assert float(nll_f) == float(nll_b), (
+            f"sample {idx[i]}: bucketed loss {float(nll_b)!r} != "
+            f"full-pad {float(nll_f)!r}")
+
+    if not decode_too:
+        return
+    # decoded tokens: bucket with FULL tar (the decode-table rule)
+    dec_geom = B.BucketGeom(GEOM.ast_len, GEOM.max_edges, cfg.tar_len)
+    dec_bucket = make_batch(split, idx, cfg, batch_size=4, geom=dec_geom)
+    tok_f, p_f = jax.jit(
+        lambda p, b: beam_search_cached(model, p, b, cfg))(params, full)
+    tok_b, p_b = jax.jit(
+        lambda p, b: beam_search_cached(model, p, b, cfg))(params, dec_bucket)
+    np.testing.assert_array_equal(np.asarray(tok_f), np.asarray(tok_b))
+    np.testing.assert_array_equal(np.asarray(p_f, np.float32),
+                                  np.asarray(p_b, np.float32))
+
+
+def test_make_batch_rejects_unfitting_samples(corpus, extents):
+    cfg, split = corpus
+    # a geometry too small for the corpus' ast extents
+    tight = B.BucketGeom(2, cfg.sou_len + cfg.sub_token_len + 2, 8)
+    bad = np.where(~extents.admissible(tight))[0][:2]
+    assert len(bad), "corpus must have samples exceeding the tight bucket"
+    with pytest.raises(ValueError, match="does not fit|edges"):
+        make_batch(split, bad, cfg, batch_size=2, geom=tight)
+    # geometry outside the config's full envelope is rejected up front
+    with pytest.raises(ValueError, match="bucket"):
+        make_batch(split, np.arange(2), cfg, batch_size=2,
+                   geom=(cfg.ast_change_len + 1, cfg.max_edges, cfg.tar_len))
+
+
+def test_packed_plan_determinism_coverage_admissibility(corpus, extents):
+    cfg0, split = corpus
+    cfg = cfg0.replace(buckets=((8, 192, 8), (16, 256, 8)))
+    table = B.bucket_table(cfg)
+    assert table[-1] == B.full_geom(cfg)
+
+    for shuffle in (False, True):
+        p1 = B.packed_plan(split, cfg, batch_size=16, shuffle=shuffle,
+                           seed=3, epoch=2)
+        p2 = B.packed_plan(split, cfg, batch_size=16, shuffle=shuffle,
+                           seed=3, epoch=2)
+        assert len(p1) == len(p2)
+        for (c1, g1), (c2, g2) in zip(p1, p2):
+            assert g1 == g2
+            np.testing.assert_array_equal(c1, c2)
+        # every sample exactly once
+        cover = np.sort(np.concatenate([c for c, _ in p1]))
+        np.testing.assert_array_equal(cover, np.arange(len(split)))
+        # every chunk admissible to its bucket
+        assignment = B.assign_buckets(extents, table)
+        for chunk, geom in p1:
+            assert extents.admissible(geom)[chunk].all()
+            b = table.index(geom)
+            assert (assignment[chunk] == b).all()
+        # a different epoch draws a different shuffled plan
+        if shuffle:
+            p3 = B.packed_plan(split, cfg, batch_size=16, shuffle=True,
+                               seed=3, epoch=3)
+            assert any(len(a) != len(b) or (a != b).any()
+                       for (a, _), (b, _) in zip(p1, p3))
+
+    # buckets=() degenerates to the exact single-geometry chunking
+    cfg_off = cfg0.replace(buckets=())
+    plan_off = B.packed_plan(split, cfg_off, batch_size=16, shuffle=True,
+                             seed=5, epoch=1)
+    chunks = epoch_index_chunks(len(split), cfg_off, batch_size=16,
+                                shuffle=True, seed=5, epoch=1)
+    assert len(plan_off) == len(chunks)
+    for (c, g), ref in zip(plan_off, chunks):
+        assert g == B.full_geom(cfg_off)
+        np.testing.assert_array_equal(c, ref)
+
+
+def test_feeder_strips_host_only_fields(corpus):
+    cfg0, split = corpus
+    cfg = cfg0.replace(buckets=((16, 256, 8),))
+    plan = B.packed_plan(split, cfg, batch_size=8)
+    tasks = B.bucketed_assembly_tasks(split, plan, cfg, batch_size=8)
+    with Feeder(tasks, num_workers=1, depth=2) as feed:
+        item = next(iter(feed))
+    assert "_positions" in item.host and "_tag" in item.host
+    assert "_positions" not in item.device and "_tag" not in item.device
+    # positions name the chunk's samples, -1 on pad rows
+    chunk = plan[0][0]
+    np.testing.assert_array_equal(item.host["_positions"][: len(chunk)],
+                                  chunk)
+    assert (item.host["_positions"][len(chunk):] == -1).all()
+
+
+def test_bucket_program_family_compile_counts(corpus):
+    """Exactly |table| programs per entry point after the warmup pass,
+    zero new compilations across a full steady pass over every geometry —
+    and the declared-family guard catches an out-of-table geometry."""
+    cfg0, split = corpus
+    # one declared bucket + the full fallback: the smallest family that
+    # exercises the N-programs-then-zero contract (each extra geometry is
+    # another ~10 s train-step compile of tier-1 budget)
+    cfg = cfg0.replace(buckets=((16, 256, 8),))
+    table = B.bucket_table(cfg)
+    model = FiraModel(cfg)
+    sample = make_batch(split, np.arange(8), cfg, batch_size=8)
+    state = init_state(model, cfg, sample)
+
+    with sanitizer.compile_capture() as watcher:
+        guard = sanitizer.CompileGuard(watcher)
+        guard.declare(f"train_step[{B.geom_tag(g)}]" for g in table)
+        step = jax.jit(step_lib.make_train_step(model, cfg))
+        # warmup pass: one all-pad batch per geometry
+        for g in table:
+            state, _ = step(state, B.warmup_batch(split, cfg, g, 8))
+            guard.step(f"train_step[{B.geom_tag(g)}]")
+        assert step._cache_size() == len(table)
+
+        # steady pass over REAL batches of every geometry: zero compiles
+        before = watcher.count
+        ext = B.sample_extents(split, cfg)
+        assignment = B.assign_buckets(ext, table)
+        for b, g in enumerate(table):
+            members = np.where(assignment == b)[0][:8]
+            if not len(members):
+                continue
+            state, m = step(state, make_batch(split, members, cfg,
+                                              batch_size=8, geom=g))
+            np.asarray(jax.device_get(m["loss"]))
+            guard.step(f"train_step[{B.geom_tag(g)}]")
+        assert watcher.count == before, "steady pass recompiled"
+        assert step._cache_size() == len(table)
+        assert guard.compiles_after_warmup() == 0
+
+        # an undeclared geometry label raises at the dispatch that made it
+        with pytest.raises(sanitizer.RetraceError, match="declared"):
+            guard.step("train_step[a99.e999.t99]")
+
+
+def test_warmup_batch_is_all_pad_and_compile_keyed(corpus):
+    cfg0, split = corpus
+    geom = B.BucketGeom(8, 192, 8)
+    wb = B.warmup_batch(split, cfg0, geom, 4)
+    assert not wb["valid"].any()
+    assert wb["ast_change"].shape == (4, 8)
+    assert wb["msg"].shape == (4, 8)
+    assert wb["senders"].shape == (4, 192)
+    real = make_batch(split, np.arange(2), cfg0, batch_size=4, geom=geom)
+    for k in wb:
+        assert wb[k].shape == real[k].shape and wb[k].dtype == real[k].dtype
+
+
+def test_bucket_table_validation(corpus):
+    cfg0, _ = corpus
+    with pytest.raises(ValueError, match="ast_len"):
+        B.bucket_table(cfg0.replace(buckets=((0, 256, 8),)))
+    with pytest.raises(ValueError, match="self-loop floor"):
+        # fewer edge slots than nodes at that geometry: nothing could fit
+        B.bucket_table(cfg0.replace(buckets=((8, 16, 8),)))
+    with pytest.raises(ValueError, match="tar_len"):
+        B.bucket_table(cfg0.replace(buckets=((8, 192, cfg0.tar_len + 1),)))
+    # declared-equal-to-full entries dedupe into the fallback
+    full = tuple(B.full_geom(cfg0))
+    assert B.bucket_table(cfg0.replace(buckets=(full,))) == (
+        B.full_geom(cfg0),)
+
+
+def test_padding_report_shrinks_under_buckets(corpus):
+    cfg0, split = corpus
+    table = B.bucket_table(
+        cfg0.replace(buckets=B.choose_buckets(split, cfg0)))
+    rep = B.padding_report(split, cfg0, table)
+    assert 0.0 <= rep["padding_frac_bucketed"] < rep["padding_frac_single"]
+    assert rep["flops_ratio_bucketed_vs_single"] < 1.0
+    assert sum(r["n"] for r in rep["buckets"]) == len(split)
+
+
+@pytest.fixture(scope="module")
+def tiny_dataset(tmp_path_factory):
+    from fira_tpu.data.dataset import FiraDataset
+    from fira_tpu.data.synthetic import write_corpus_dir
+
+    data_dir = str(tmp_path_factory.mktemp("bucket_corpus"))
+    write_corpus_dir(data_dir, n_commits=28, seed=7)
+    cfg = fira_tiny(epochs=1, batch_size=8, test_batch_size=4,
+                    dev_start_epoch=0, dev_every_batches=4)
+    return FiraDataset(data_dir, cfg)
+
+
+def test_train_and_decode_end_to_end_with_buckets(tiny_dataset, tmp_path):
+    """Drivers end-to-end: train() pre-warms the family and runs a gated
+    epoch under the sanitizer with zero post-warmup compiles; run_test
+    with buckets writes a byte-identical output file to the unbucketed
+    decode of the same params (packing reorders the stream, the positions
+    field restores corpus order)."""
+    from fira_tpu.decode.runner import run_test
+    from fira_tpu.train.loop import train
+
+    ds = tiny_dataset
+    # one declared bucket keeps the warmed family small (choose_buckets
+    # itself is covered by test_padding_report_shrinks_under_buckets)
+    cfg_b = ds.cfg.replace(buckets=((16, 256, 8),))
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        result = train(ds, cfg_b, out_dir=str(tmp_path / "out"),
+                       ckpt_dir=str(tmp_path / "ckpt"), epochs=1,
+                       resume=False, guard=guard)
+    assert result.epochs_run == 1
+    assert guard.compiles_after_warmup() == 0
+    # every seen label belongs to the declared bucket family
+    assert all("[" in lbl for lbl in guard._seen)
+    assert any(lbl.startswith("train_step[") for lbl in guard._seen)
+    assert any(lbl.startswith("dev_step[") for lbl in guard._seen)
+
+    params = result.state.params
+    m_off = run_test(FiraModel(ds.cfg), params, ds, ds.cfg,
+                     out_dir=str(tmp_path / "dec_off"))
+    with sanitizer.sanitize(nans=False, infs=False) as g2:
+        m_on = run_test(FiraModel(cfg_b), params, ds, cfg_b,
+                        out_dir=str(tmp_path / "dec_on"), guard=g2)
+    assert g2.compiles_after_warmup() == 0
+    with open(os.path.join(tmp_path, "dec_off", "output_fira")) as f:
+        off_text = f.read()
+    with open(os.path.join(tmp_path, "dec_on", "output_fira")) as f:
+        on_text = f.read()
+    assert off_text == on_text
+    assert m_on["n"] == m_off["n"] == len(ds.splits["test"])
+    np.testing.assert_allclose(m_on["sentence_bleu"], m_off["sentence_bleu"],
+                               rtol=1e-12)
+
+
+def test_buckets_reject_grouped_dispatch(tiny_dataset, tmp_path):
+    from fira_tpu.train.loop import train
+
+    ds = tiny_dataset
+    cfg = ds.cfg.replace(buckets=((16, 256, 8),), fused_steps=2)
+    with pytest.raises(ValueError, match="per-step dispatch"):
+        train(ds, cfg, out_dir=str(tmp_path / "o"),
+              ckpt_dir=str(tmp_path / "c"), epochs=1, resume=False)

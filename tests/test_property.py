@@ -7,7 +7,13 @@ The FSM property IS the reference's own global assert
 here quantified over generated inputs instead of one corpus.
 """
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# gate, don't crash collection: hypothesis is absent from some build
+# containers, and an un-importable module reads as a tier-1 ERROR instead
+# of the skip it really is
+pytest.importorskip("hypothesis", reason="hypothesis not installed")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from fira_tpu.preprocess import extract
 from fira_tpu.preprocess.fsm import flatten_chunks, split_hunks
