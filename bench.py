@@ -116,7 +116,20 @@ FIRA_BENCH_PRODUCTION_KNOBS (JSON FiraConfig fields applied by default —
 the measured stacked production config: rbg dropout PRNG, fused_steps=8
 device loop, sorted scatters, bf16 residual streams, no copy-head remat
 (docs/PERF.md round-4 table); '{}' benches the parity-default knobs),
-FIRA_BENCH_OVERRIDES (JSON FiraConfig fields, wins over both).
+FIRA_BENCH_OVERRIDES (JSON FiraConfig fields, wins over both),
+FIRA_BENCH_COMPOSED=0 (skip the composed leg), FIRA_BENCH_COMPOSED_DATA
+(corpus size for the composed leg; default 3*K*batch so each auto bucket
+can fill K-groups).
+
+Composed leg — the production path going forward (ISSUE 4): the stacked
+knobs AND the auto bucket table together. One shuffled epoch plan of
+bucket-HOMOGENEOUS K-groups (data/grouping.py) runs device-resident
+through the per-(geometry, K) program family; the record carries
+``value_composed`` plus dispatch-count and padding_frac accounting
+(``composed.{dispatches,grouped_dispatches,per_step_dispatches,
+steps_dispatched,commits,padding_frac_dispatched}``), so every bench
+artifact prices what grouping + bucketing actually dispatched. ``value``
+stays the single-geometry compute leg for cross-round ledger continuity.
 """
 
 from __future__ import annotations
@@ -351,8 +364,17 @@ def worker() -> None:
 
     # synthetic corpus; at the flagship geometry vocabs pad to the
     # reference's 24,650 words / 71 labels so the fused 25,020-way output
-    # costs what the real run costs
+    # costs what the real run costs. The composed leg needs enough samples
+    # for each auto bucket to fill K-groups of full batches, so the corpus
+    # grows to 3*K*batch (three auto buckets) when that leg is on — ONE
+    # corpus serves every leg (a second one could drift the synthetic
+    # vocab away from the params' embedding tables).
     n_data = int(os.environ.get("FIRA_BENCH_DATA", "512"))
+    run_composed = os.environ.get("FIRA_BENCH_COMPOSED", "1") != "0"
+    if run_composed:
+        n_data = max(n_data, int(os.environ.get(
+            "FIRA_BENCH_COMPOSED_DATA",
+            str(3 * max(1, cfg.fused_steps) * batch_size))))
     pad_vocab = 24650 if cfg_name == "fira-full" else 0
     cfg, split, _ = make_memory_split(
         cfg, n_data, seed=0, pad_vocab_to=pad_vocab,
@@ -539,6 +561,81 @@ def worker() -> None:
     # the step above is jitted without a mesh: it runs on exactly one chip
     # regardless of how many are visible
     n_chips = 1
+
+    # (d) COMPOSED leg — stacked knobs x auto buckets, the production path
+    # (ISSUE 4): one shuffled epoch of bucket-homogeneous K-groups
+    # (data/grouping.py) runs device-resident through the per-(geometry, K)
+    # program family — fused tails per-step, exactly what train/loop.py
+    # dispatches — with dispatch-count + padded-FLOP accounting on the
+    # record. Accounting/compile failures must never sink the main
+    # measurement: the leg degrades to a structured error field.
+    composed = None
+    if run_composed:
+        try:
+            from fira_tpu.data import buckets as buckets_lib2
+            from fira_tpu.data import grouping
+
+            cfg_comp = cfg.replace(
+                buckets=buckets_lib2.choose_buckets(split, cfg))
+            table = buckets_lib2.bucket_table(cfg_comp)
+            ext = buckets_lib2.sample_extents(split, cfg_comp)
+            plan = grouping.grouped_plan(
+                split, cfg_comp, batch_size=batch_size, group_size=K,
+                accum=False, shuffle=True, seed=0, epoch=0, table=table,
+                assignment=buckets_lib2.assign_buckets(ext, table))
+            acct = grouping.plan_report(split, cfg_comp, plan,
+                                        batch_size=batch_size, extents=ext)
+            items = []
+            for task in grouping.grouped_assembly_tasks(
+                    split, plan, cfg_comp, batch_size=batch_size,
+                    bucketed=True):
+                host = task()
+                wire = {kk: vv for kk, vv in host.items()
+                        if not kk.startswith("_")}
+                items.append((jax.device_put(wire),
+                              wire["valid"].ndim == 2))
+            jax.block_until_ready([d for d, _ in items])
+            step_comp = jax.jit(step_lib.make_train_step(model, cfg_comp),
+                                donate_argnums=(0,))
+            multi_comp = (jax.jit(step_lib.make_multi_step(model, cfg_comp),
+                                  donate_argnums=(0,))
+                          if K > 1 else None)
+
+            def composed_pass():
+                m = None
+                for dev_b, stacked in items:
+                    state_box[0], m = (multi_comp if stacked
+                                       else step_comp)(state_box[0], dev_b)
+                return m
+
+            # pass 0 compiles the whole (geometry x entrypoint x K) family
+            # — jit's shape cache specializes per member, like the train
+            # loop's pre-warm — then steady passes are compile-free
+            m = composed_pass()
+            float(np.asarray(jax.device_get(m["loss"])).ravel()[-1])
+            ctimes = []
+            for _w in range(n_windows):
+                t0 = time.perf_counter()
+                m = composed_pass()
+                loss = float(np.asarray(
+                    jax.device_get(m["loss"])).ravel()[-1])
+                ctimes.append(time.perf_counter() - t0)
+                if not math.isfinite(loss):
+                    raise RuntimeError(
+                        f"non-finite loss {loss} in composed pass {_w}")
+            dt_comp = sorted(ctimes)[len(ctimes) // 2]
+            composed = {
+                "value": round(acct["commits"] / dt_comp / n_chips, 2),
+                "unit": UNIT,
+                "value_basis": "compute",
+                "step_time_s": round(dt_comp / acct["steps_dispatched"], 5),
+                "group_size": K,
+                "buckets": [buckets_lib2.geom_tag(g) for g in table],
+                **acct,
+            }
+        except Exception as e:
+            print(f"composed leg failed: {e!r}", file=sys.stderr)
+            composed = {"error": repr(e)}
     step_time = dt_e2e / steps_per_window
     compute_step_time = dt_compute / steps_per_window
     # metric of record: chip-side throughput (see module docstring "History
@@ -580,6 +677,10 @@ def worker() -> None:
         **({"padding_frac_single": pad_report["padding_frac_single"],
             "padding_frac_bucketed": pad_report["padding_frac_bucketed"],
             "bucket_report": pad_report["buckets"]} if pad_report else {}),
+        # composed production path (stacked knobs x buckets): throughput
+        # plus dispatch-count + dispatched-padding accounting
+        **({"value_composed": composed.get("value"),
+            "composed": composed} if composed else {}),
         "feed_stall_frac_sync_assembly": sync_info["feed_stall_frac"],
         "value_e2e_sync_assembly": round(
             batch_size / (dt_sync / steps_per_window) / n_chips, 2),

@@ -39,14 +39,17 @@ _JIT_CALLS = {"jax.jit", "jit", "jax.pmap", "pmap", "pjit", "jax.pjit"}
 # train loop, the train-step factories, the decode drivers, the async
 # input pipeline (its dispatcher/worker/consumer loops run concurrently
 # with every step dispatch — a sync there stalls the feed exactly like one
-# in the train loop), and the bucket packer (its packing/assembly loops
-# run as feeder tasks on the same worker threads). NOT every train/decode
+# in the train loop), the bucket packer (its packing/assembly loops
+# run as feeder tasks on the same worker threads), and the grouped
+# scheduler (data/grouping.py — its plan walk and K-stack assembly run on
+# the same feeder workers, one task per dispatch). NOT every train/decode
 # module — e.g. decode/text.py is host-only text cooking and
 # train/state.py is checkpoint I/O (already a boundary by definition).
 _DRIVER_FILES = (
     "fira_tpu/train/loop.py", "fira_tpu/train/step.py",
     "fira_tpu/decode/runner.py", "fira_tpu/decode/beam.py",
     "fira_tpu/data/feeder.py", "fira_tpu/data/buckets.py",
+    "fira_tpu/data/grouping.py",
 )
 
 

@@ -38,6 +38,25 @@ class RetraceError(RuntimeError):
     """A post-warmup step triggered a fresh XLA compilation."""
 
 
+def program_label(kind: str, tag: Optional[str] = None, group: int = 1) -> str:
+    """Canonical label for one member of the (geometry x entrypoint x
+    group-size) program family — the single format every driver labels and
+    declares with, so the declared-family check can close over grouped
+    programs too:
+
+    ``program_label('train_step')``                    -> ``train_step``
+    ``program_label('train_step', 'a16.e256.t8')``     -> ``train_step[a16.e256.t8]``
+    ``program_label('grouped_step', 'a16.e256.t8', 8)``-> ``grouped_step[a16.e256.t8.g8]``
+    ``program_label('grouped_step', None, 8)``         -> ``grouped_step[g8]``
+
+    ``tag`` is a bucket geometry tag (data.buckets.geom_tag) or None;
+    ``group`` > 1 is the stacked leading dim (fused K / accum A), so a
+    grouped program at an undeclared (geom, K) raises at the dispatch that
+    produced it, not as a mystery recompile."""
+    mods = ".".join(m for m in (tag, f"g{group}" if group > 1 else None) if m)
+    return f"{kind}[{mods}]" if mods else kind
+
+
 class CompileWatcher(logging.Handler):
     """Counts XLA compilations by listening to jax's log_compiles records.
 
@@ -69,12 +88,15 @@ class CompileGuard:
     """Per-program-label compile budget: 1 warmup dispatch, then zero.
 
     With a bucketed geometry family (data/buckets.py) every bucket's
-    program gets its own label (``train_step[a16.e256.t8]``): N programs
-    warm up, then still zero post-warmup compiles. Drivers additionally
-    :meth:`declare` the family after pre-warming — from then on a
-    dispatch under an UNDECLARED label raises, so a geometry outside the
-    declared bucket table (shape drift, a mis-packed batch) is caught at
-    the step that produced it, not as a mystery recompile."""
+    program gets its own label (``train_step[a16.e256.t8]``), and grouped
+    dispatch (data/grouping.py) widens the family along the group-size
+    axis (``grouped_step[a16.e256.t8.g8]`` — see :func:`program_label`):
+    N programs warm up, then still zero post-warmup compiles. Drivers
+    additionally :meth:`declare` the family after pre-warming — from then
+    on a dispatch under an UNDECLARED label raises, so a geometry or
+    group size outside the declared (geom, K) table (shape drift, a
+    mis-packed batch) is caught at the step that produced it, not as a
+    mystery recompile."""
 
     watcher: CompileWatcher
     _last_count: int = 0

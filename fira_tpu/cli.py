@@ -134,7 +134,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "<= the config's full values. Each sample packs "
                         "into its smallest admissible bucket; one "
                         "pre-warmed program per bucket, zero post-warmup "
-                        "retraces. Requires fused/accum steps = 1")
+                        "retraces. Composes with --fused-steps/"
+                        "--accum-steps: groups pack bucket-homogeneous "
+                        "K-stacks (docs/BUCKETING.md Composition)")
     p.add_argument("--sanitize", action="store_true",
                    help="arm the runtime sanitizer (analysis.sanitizer): "
                         "jax_debug_nans/jax_debug_infs on every program, "
@@ -148,20 +150,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "fastest TPU config (config.PRODUCTION_PERF_KNOBS: "
                         "rbg dropout PRNG, fused device loop, sorted "
                         "scatters, bf16 residual streams, no copy-head "
-                        "remat — docs/PERF.md); 'parity' (default) keeps "
-                        "the reference-parity knob defaults. Individual "
-                        "flags override the preset either way")
+                        "remat — docs/PERF.md) plus the equivalence-pinned "
+                        "beam set (config.DECODE_PERF_KNOBS: kv cache, "
+                        "factored top-k, early exit); 'parity' (default) "
+                        "keeps the reference-parity knob defaults. "
+                        "Individual flags override the preset either way")
     return p
 
 
 def _resolve_cfg(args):
-    from fira_tpu.config import (PRODUCTION_PERF_KNOBS, apply_ablation,
-                                 get_config)
+    from fira_tpu.config import (DECODE_PERF_KNOBS, PRODUCTION_PERF_KNOBS,
+                                 apply_ablation, get_config)
 
     cfg = get_config(args.config.replace("_", "-"))
     cfg = apply_ablation(cfg, args.ablation)
     if args.perf == "production":
-        cfg = cfg.replace(**PRODUCTION_PERF_KNOBS)
+        # train-side stacked knobs + the decode-side beam set (the latter
+        # only matters when beam decode runs; every member is
+        # equivalence-pinned — config.DECODE_PERF_KNOBS)
+        cfg = cfg.replace(**PRODUCTION_PERF_KNOBS, **DECODE_PERF_KNOBS)
     overrides = {}
     if args.batch_size:
         overrides["batch_size"] = args.batch_size
@@ -271,6 +278,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     return 2
                 entries.append(tuple(int(f) for f in fields))
             table = tuple(entries)
+            # range-validate against the resolved config HERE (the same
+            # friendly exit the format check gets) instead of letting
+            # buckets._validated raise a deep traceback mid-run
+            try:
+                buckets_lib.bucket_table(cfg.replace(buckets=table))
+            except ValueError as e:
+                print(f"--buckets invalid: {e}; see docs/BUCKETING.md",
+                      file=sys.stderr)
+                return 2
         cfg = cfg.replace(buckets=table)
         print(f"buckets: {', '.join(f'{a}:{e}:{t}' for a, e, t in table)} "
               f"(+ full fallback)")

@@ -165,6 +165,8 @@ class FiraConfig:
     # Mutually exclusive with fused_steps>1. Epoch tails smaller than A run
     # as ONE accumulated step padded with all-invalid micro-batches — the
     # same smaller-final-batch dynamics as the reference's DataLoader tail.
+    # Composes with cfg.buckets: the grouped scheduler (data/grouping.py)
+    # packs A same-geometry micro-batches per dispatch, per bucket.
     accum_steps: int = 1
 
     # --- device loop ---
@@ -177,7 +179,10 @@ class FiraConfig:
     # up to K-1 steps stale and multiple due gates inside one group collapse
     # to one — pick K dividing dev_every_batches (then the only staleness is
     # the gate-before-group ordering, same as the reference's evaluate-then-
-    # train batch loop). Epoch-tail batches (< K) run per-step.
+    # train batch loop; train() now warns loudly — console + TrainResult
+    # .warnings — when K does not divide the cadence). Epoch-tail batches
+    # (< K) run per-step. Composes with cfg.buckets: the grouped scheduler
+    # (data/grouping.py) packs K same-geometry batches per dispatch.
     fused_steps: int = 1
 
     # --- host input pipeline (data/feeder.py; docs/PIPELINE.md) ---
@@ -203,9 +208,13 @@ class FiraConfig:
     # post-warmup retraces (the sanitizer learns the declared family).
     # () = off: the single-geometry path, byte-identical batches.
     # sou_len/sub_token_len are NOT bucketable (the copy-label id space
-    # and fused output width bake them in). Composes with per-step
-    # dispatch only: fused_steps/accum_steps > 1 raises. The CLI's
-    # --buckets auto fills this from the corpus length histograms.
+    # and fused output width bake them in). Composes with the grouped
+    # device programs: fused_steps/accum_steps > 1 makes the scheduler
+    # (data/grouping.py) pack bucket-HOMOGENEOUS groups of K (or A)
+    # same-geometry batches per dispatch — the program family becomes
+    # (geometry x entrypoint x group size), all pre-warmed, still zero
+    # post-warmup retraces. The CLI's --buckets auto fills this from the
+    # corpus length histograms.
     buckets: tuple = ()
 
     # --- long context ---
@@ -288,6 +297,24 @@ PRODUCTION_PERF_KNOBS = {
     "sort_edges": True,
     "stable_residual": False,
     "copy_head_remat": False,
+}
+
+
+# The decode-side production set (VERDICT r5 item 5, the CPU-provable
+# half): the three beam levers whose output equivalence is already pinned —
+# beam_kv_cache (token-identical to full-prefix re-decode), factored
+# per-side top-k (token-exact vs the assembled 25,020-way fused tensor),
+# and the while_loop early exit (bit-exact tokens AND probs in all four
+# kv x factored modes, tests/test_beam_early_exit.py). TPU bracket rows
+# for the set (DECODE_BATCH 170/512, random + eos-saturated paramsets) are
+# queued in the watchdog harvest (scripts/tpu_watchdog2.sh ->
+# scripts/tpu_decode_bench.py); per-config defaults stay parity until
+# those rows land. `--perf production` on the CLI applies this set
+# alongside PRODUCTION_PERF_KNOBS.
+DECODE_PERF_KNOBS = {
+    "beam_kv_cache": True,
+    "beam_factored_topk": True,
+    "beam_early_exit": True,
 }
 
 

@@ -56,6 +56,14 @@ programs get their own compile-guard label (``train_step[a16.e256.t8]``),
 drivers pre-warm and then ``CompileGuard.declare`` the family, and a
 dispatch outside the declared family raises — geometry drift is a
 machine-enforced non-event, not a recompile storm.
+
+Composition with the grouped device programs (fused_steps / accum_steps)
+lives in data/grouping.py: its scheduler walks the same permutation,
+reuses this module's table/assignment/extents machinery, and packs
+bucket-HOMOGENEOUS K-groups so the padding win and the dispatch-
+amortization win stack. ``packed_plan`` below stays the dev/decode packer
+(stable partition, sort-by-length) and the ``group_size == 1`` reference
+the grouped plan is pinned equal to.
 """
 
 from __future__ import annotations

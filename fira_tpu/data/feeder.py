@@ -20,7 +20,12 @@ training loop:
   yet consumed) — host memory stays O(depth * batch_bytes).
 - **transfer**: each worker finishes its task with a (sharded)
   ``jax.device_put``, which is asynchronous — the transfer of batch i+1
-  overlaps the compute of batch i, same as the old prefetcher.
+  overlaps the compute of batch i, same as the old prefetcher. A grouped
+  dispatch item (data/grouping.py: a K-stacked same-geometry batch for the
+  fused device loop / gradient accumulation) is assembled AND transferred
+  by ONE task on one worker, so the whole K-group ships as a single
+  ``device_put`` instead of K round-trips; ``n_valid`` sums the 2-D
+  ``valid`` of a stacked group the same way it sums the 1-D one.
 - **errors**: the first worker/dispatcher exception is re-raised at the
   consumer on its next ``__next__`` (not deferred until the failing
   sequence number comes up), so a poisoned pipeline surfaces within one

@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
+from fira_tpu.analysis.sanitizer import program_label
 from fira_tpu.config import FiraConfig
 from fira_tpu.data import buckets as buckets_lib
 from fira_tpu.data.batching import epoch_index_chunks
@@ -59,13 +60,15 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
     if cfg.buckets:
         table = buckets_lib.decode_table(cfg)
         if guard is not None:
-            guard.declare(f"beam_search[{buckets_lib.geom_tag(g)}]"
+            guard.declare(program_label("beam_search",
+                                        buckets_lib.geom_tag(g))
                           for g in table)
         for g in table:
             beam(params, buckets_lib.warmup_batch(data, cfg, g,
                                                   cfg.test_batch_size))
             if guard is not None:
-                guard.step(f"beam_search[{buckets_lib.geom_tag(g)}]")
+                guard.step(program_label("beam_search",
+                                         buckets_lib.geom_tag(g)))
         plan = buckets_lib.packed_plan(data, cfg,
                                        batch_size=cfg.test_batch_size,
                                        table=table, use_msg=False)
@@ -107,8 +110,7 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
             probs = np.asarray(jax.device_get(probs))  # firacheck: allow[HOST-SYNC] same decode output boundary as the line above
             positions = batch.get("_positions")  # bucketed stream only
             if guard is not None:
-                tag = batch.get("_tag")
-                guard.step(f"beam_search[{tag}]" if tag else "beam_search")
+                guard.step(program_label("beam_search", batch.get("_tag")))
             valid = batch["valid"]  # host-side numpy batch field, no sync
             for i in range(tokens.shape[0]):
                 if not valid[i]:

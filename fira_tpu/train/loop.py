@@ -1,10 +1,12 @@
 """Training driver: epochs, dev gating, checkpointing, throughput metering.
 
 Rebuilds the reference's train/dev orchestration
-(/root/reference/run_model.py:83-184) TPU-first: one compiled train step and
-one compiled dev step run for the whole session; batches stream through
-fixed shapes; throughput is reported as commits/sec/chip (the repo's metric
-of record, BASELINE.md).
+(/root/reference/run_model.py:83-184) TPU-first: a SMALL FIXED FAMILY of
+compiled programs runs for the whole session — per-step/grouped train
+steps x bucket geometries x dev (data/grouping.py, data/buckets.py), all
+pre-warmed at startup when bucketed; batches stream through fixed shapes;
+throughput is reported as commits/sec/chip (the repo's metric of record,
+BASELINE.md).
 
 Reference semantics kept:
 - dev-gate cadence ``epoch >= dev_start_epoch and batch_idx % dev_every == 0``
@@ -27,8 +29,10 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
+from fira_tpu.analysis.sanitizer import program_label as sanitizer_label
 from fira_tpu.config import FiraConfig
 from fira_tpu.data import buckets as buckets_lib
+from fira_tpu.data import grouping
 from fira_tpu.data.batching import epoch_index_chunks, make_batch
 from fira_tpu.data.dataset import FiraDataset
 from fira_tpu.data.feeder import Feeder, assembly_tasks
@@ -64,7 +68,7 @@ class TrainLog:
         print(msg, flush=True)
 
 
-def _eval_tasks(data, cfg: FiraConfig):
+def _eval_tasks(data, cfg: FiraConfig, plan=None):
     """Assembly tasks for the dev pass: the single-geometry sequential
     chunks when buckets are off (the byte-identical legacy stream), the
     bucketed sort-by-length plan when on. Dev packs with the DECODE bucket
@@ -73,12 +77,15 @@ def _eval_tasks(data, cfg: FiraConfig):
     EVERY tar position (even pad-conditioned ones, run_model.py:118-184),
     so truncating tar would change the metric; with tar full the per-line
     dev output is bit-identical to the unbucketed pass (pinned by
-    tests/test_buckets.py)."""
+    tests/test_buckets.py). ``plan``: a precomputed packed plan for the
+    split — the shuffle=False plan never changes, so train() computes it
+    once instead of re-deriving extents/assignment at every dev gate."""
     if cfg.buckets:
-        table = buckets_lib.decode_table(cfg)
-        plan = buckets_lib.packed_plan(data, cfg,
-                                       batch_size=cfg.test_batch_size,
-                                       table=table, use_msg=False)
+        if plan is None:
+            plan = buckets_lib.packed_plan(data, cfg,
+                                           batch_size=cfg.test_batch_size,
+                                           table=buckets_lib.decode_table(cfg),
+                                           use_msg=False)
         return buckets_lib.bucketed_assembly_tasks(
             data, plan, cfg, batch_size=cfg.test_batch_size)
     chunks = epoch_index_chunks(len(data), cfg, batch_size=cfg.test_batch_size)
@@ -87,7 +94,8 @@ def _eval_tasks(data, cfg: FiraConfig):
 
 def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
             var_maps: Optional[List[Dict[str, str]]] = None,
-            split: str = "valid", guard=None) -> tuple[float, str]:
+            split: str = "valid", guard=None,
+            eval_plan=None) -> tuple[float, str]:
     """Greedy teacher-forced validation (run_model.py:118-184). Returns
     (mean sentence BLEU over the split, dev_output text — always in split
     order, even when the bucket packer reordered the batch stream)."""
@@ -97,7 +105,7 @@ def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
     total_bleu = 0.0
     out_lines: List[tuple] = []  # (split position, line)
     cursor = 0
-    with Feeder(_eval_tasks(data, cfg),
+    with Feeder(_eval_tasks(data, cfg, plan=eval_plan),
                 num_workers=cfg.feeder_workers,
                 depth=cfg.feeder_depth) as feed:
         for item in feed:
@@ -107,8 +115,7 @@ def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
             valid = batch["valid"]  # host-side numpy batch field, no device trip
             positions = batch.get("_positions")  # bucketed stream only
             if guard is not None:
-                tag = batch.get("_tag")
-                guard.step(f"dev_step[{tag}]" if tag else "dev_step")
+                guard.step(sanitizer_label("dev_step", batch.get("_tag")))
             for i in range(ids.shape[0]):
                 if not valid[i]:
                     continue
@@ -152,6 +159,11 @@ class TrainResult:
     # aggregated data/feeder.Feeder stats over the run: batches,
     # feed_stall_s, queue_depth_mean/min, num_workers, depth
     feeder: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # loud-but-nonfatal run conditions (also printed to the console):
+    # fused_steps not dividing dev_every_batches (gate-staleness footgun,
+    # config.py), profiling annotations spanning K-step grouped dispatches —
+    # anything a reader of this run's numbers must know to read them right
+    warnings: List[str] = dataclasses.field(default_factory=list)
 
 
 def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
@@ -229,6 +241,7 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
     # step); viewable in TensorBoard / xprof.
     profile_window = (range(2, 2 + profile_steps) if profile_dir else range(0))
     profiling_active = False
+    profile_done = False
     global_step = 0
 
     # Double-buffered device feed: batch i+1 transfers while step i runs
@@ -245,19 +258,39 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
     #   accum_steps A   > 1: A-groups accumulate into ONE optimizer step
     #                        normalized over the global (sum, count) — the
     #                        reference's DataParallel batch-680 dynamics
-    # The epoch tail (< group size) uses the per-step program either way.
-    # Per-step profiling wants one annotation per dispatch, so --profile-dir
-    # falls back to per-step.
+    # The epoch tail (< group size) uses the per-step program under fused
+    # and pads to the stacked shape with all-invalid micro-batches under
+    # accum. Both COMPOSE with cfg.buckets: the grouped scheduler
+    # (data/grouping.py) packs bucket-homogeneous groups over the same
+    # epoch permutation, so each dispatch is one member of the
+    # (geometry x entrypoint x group-size) program family.
     fused = max(1, int(cfg.fused_steps))
     accum = max(1, int(cfg.accum_steps))
     if fused > 1 and accum > 1:
         raise ValueError("fused_steps and accum_steps are mutually "
                          "exclusive (one scans steps, one accumulates "
                          "gradients); set at most one > 1")
+    warnings: List[str] = []
+    if fused > 1 and cfg.dev_every_batches % fused:
+        # the gate-staleness footgun documented at cfg.fused_steps: gates
+        # due inside a K-group collapse to one, fired BEFORE the group with
+        # up-to-K-1-steps-stale params — loud here, recorded in the result
+        w = (f"fused_steps={fused} does not divide dev_every_batches="
+             f"{cfg.dev_every_batches}: dev gates due inside a fused group "
+             f"collapse to one gate fired before the group (params up to "
+             f"{fused - 1} steps stale); pick K dividing the cadence "
+             f"(config.py fused_steps note)")
+        log.console(f"WARNING: {w}")
+        warnings.append(w)
     if (fused > 1 or accum > 1) and profile_dir:
-        log.console("fused_steps/accum_steps disabled under --profile-dir "
-                    "(per-step trace annotations)")
-        fused = accum = 1
+        # the REAL grouped program is profiled (not a per-step downgrade —
+        # profiled numbers must be production-path numbers); each trace
+        # annotation then spans one whole K-step dispatch
+        w = (f"profiling the grouped program: each step annotation spans "
+             f"one {'fused' if fused > 1 else 'accum'} dispatch of "
+             f"{fused if fused > 1 else accum} stacked batches")
+        log.console(w)
+        warnings.append(w)
     group_size = fused if fused > 1 else accum
     grouped_step = None
     if group_size > 1:
@@ -268,28 +301,37 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
 
     # --- bucketed geometry family (data/buckets.py; docs/BUCKETING.md) ---
     # Table + per-sample assignment computed ONCE for the train split; the
-    # whole program family is pre-warmed here — each bucket's train/dev
-    # program compiles against a throwaway state copy and an all-pad batch
-    # (zero training effect), so the epoch loop never compiles again. The
-    # guard then learns the closed family: every bucket label gets its one
-    # warmup dispatch, and any label outside the declared set raises.
-    bucket_table = bucket_assignment = None
+    # whole (geometry x entrypoint x group-size) program family is
+    # pre-warmed here — each member compiles against a throwaway state copy
+    # and an all-pad batch (zero training effect), so the epoch loop never
+    # compiles again. The guard then learns the closed family: every label
+    # gets its one warmup dispatch, and any label outside the declared set
+    # raises. Under fused the per-step program is warmed too (epoch tails
+    # dispatch it); under accum it never runs (tails pad to the stacked
+    # shape), so only the grouped member is warmed per geometry.
+    bucket_table = bucket_assignment = dev_plan = None
     if cfg.buckets:
-        if group_size > 1:
-            raise ValueError(
-                "buckets compose with per-step dispatch only: set "
-                "fused_steps/accum_steps to 1 (stacked groups would need "
-                "same-bucket grouping, which the packer does not do)")
         bucket_table = buckets_lib.bucket_table(cfg)
         bucket_assignment = buckets_lib.assign_buckets(
             buckets_lib.sample_extents(train_split, cfg), bucket_table)
+        warm_per_step = group_size == 1 or fused > 1
         # dev packs with the decode table (tar pinned full — the gating
-        # metric scores every tar position, see _eval_tasks)
+        # metric scores every tar position, see _eval_tasks); the dev plan
+        # is shuffle=False and never changes, so compute it ONCE here
+        # instead of re-deriving extents/assignment at every dev gate
         dev_geoms = buckets_lib.decode_table(cfg)
-        labels = ([f"train_step[{buckets_lib.geom_tag(g)}]"
-                   for g in bucket_table]
-                  + [f"dev_step[{buckets_lib.geom_tag(g)}]"
-                     for g in dev_geoms])
+        dev_plan = buckets_lib.packed_plan(
+            dataset.splits["valid"], cfg, batch_size=cfg.test_batch_size,
+            table=dev_geoms, use_msg=False)
+        labels = [sanitizer_label("dev_step", buckets_lib.geom_tag(g))
+                  for g in dev_geoms]
+        for g in bucket_table:
+            tag = buckets_lib.geom_tag(g)
+            if warm_per_step:
+                labels.append(sanitizer_label("train_step", tag))
+            if group_size > 1:
+                labels.append(sanitizer_label("grouped_step", tag,
+                                              group_size))
         if guard is not None:
             guard.declare(labels)
         # donation-safe throwaway copy: the real state (and its PRNG) is
@@ -299,71 +341,53 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                                      step_lib.state_shardings(state, mesh))
                       if mesh is not None else jax.device_put(host_state))
         for g in bucket_table:
+            tag = buckets_lib.geom_tag(g)
             wb = buckets_lib.warmup_batch(train_split, cfg, g,
                                           cfg.batch_size)
-            warm_state, wm = train_step(warm_state, wb)
-            if guard is not None:
-                guard.step(f"train_step[{buckets_lib.geom_tag(g)}]")
+            if warm_per_step:
+                warm_state, wm = train_step(warm_state, wb)
+                if guard is not None:
+                    guard.step(sanitizer_label("train_step", tag))
+            if group_size > 1:
+                swb = grouping.stack_group([wb] * group_size)
+                warm_state, wm = grouped_step(warm_state, swb)
+                if guard is not None:
+                    guard.step(sanitizer_label("grouped_step", tag,
+                                               group_size))
         for g in dev_geoms:
             wb = buckets_lib.warmup_batch(train_split, cfg, g,
                                           cfg.test_batch_size)
             dev_step(state.params, wb)
             if guard is not None:
-                guard.step(f"dev_step[{buckets_lib.geom_tag(g)}]")
+                guard.step(sanitizer_label("dev_step",
+                                           buckets_lib.geom_tag(g)))
         _materialize(wm["loss"])  # startup warmup boundary, pre-metering
         del warm_state, host_state
-        log.console(f"buckets: pre-warmed {len(bucket_table)} train + "
-                    f"{len(dev_geoms)} dev programs "
-                    f"({', '.join(buckets_lib.geom_tag(g) for g in bucket_table)})")
+        log.console(
+            f"buckets: pre-warmed "
+            f"{len(bucket_table) * (1 if warm_per_step else 0)} train + "
+            f"{len(bucket_table) * (1 if group_size > 1 else 0)} grouped"
+            f"{f'(g{group_size})' if group_size > 1 else ''} + "
+            f"{len(dev_geoms)} dev programs "
+            f"({', '.join(buckets_lib.geom_tag(g) for g in bucket_table)})")
         meter.start()  # warmup/compile time is not train time
 
     def epoch_tasks(epoch: int):
         """Zero-arg assembly tasks in the exact deterministic (seed, epoch)
-        batch order: stacked groups then un-stacked tail batches (or the
-        bucket packer's greedy walk over the SAME permutation when
-        cfg.buckets is on). Each task builds ONE dispatch item, so
+        batch order — ONE scheduler for every mode (data/grouping.py):
+        per-step mode reproduces the legacy chunking/packing byte-for-byte,
+        grouped mode packs bucket-homogeneous K-stacks over the SAME
+        permutation (fused tails per-step, accum tails padded with
+        all-invalid micro-batches). Each task builds ONE dispatch item, so
         independent items assemble in parallel on the feeder's workers."""
-        if bucket_table is not None:
-            plan = buckets_lib.packed_plan(
-                train_split, cfg, batch_size=cfg.batch_size, shuffle=True,
-                seed=cfg.seed, epoch=epoch, table=bucket_table,
-                assignment=bucket_assignment)
-            yield from buckets_lib.bucketed_assembly_tasks(
-                train_split, plan, cfg, batch_size=cfg.batch_size)
-            return
-        chunks = epoch_index_chunks(len(train_split), cfg, shuffle=True,
-                                    seed=cfg.seed, epoch=epoch)
-        if group_size == 1:
-            yield from assembly_tasks(train_split, chunks, cfg,
-                                      batch_size=cfg.batch_size)
-            return
-
-        def stacked_task(group_chunks):
-            def build():
-                group = [make_batch(train_split, c, cfg,
-                                    batch_size=cfg.batch_size)
-                         for c in group_chunks]
-                if len(group) < group_size:
-                    # Accum tail: pad to the group shape with all-zero
-                    # micro-batches (zero rows have label==0 everywhere, so
-                    # they contribute nothing to nll_sum or token count —
-                    # the same mechanism that makes make_batch's pad rows
-                    # free). The tail is then ONE optimizer step normalized
-                    # over the real samples' global (sum, count) — the
-                    # reference DataLoader's smaller final batch, not up to
-                    # A-1 separate full steps.
-                    pad = jax.tree_util.tree_map(np.zeros_like, group[0])
-                    group = group + [pad] * (group_size - len(group))
-                return step_lib.stack_batches(group)
-            return build
-
-        for start in range(0, len(chunks), group_size):
-            grp = chunks[start : start + group_size]
-            if len(grp) == group_size or accum > 1:
-                yield stacked_task(grp)
-            else:  # fused tail (< K batches) runs per-step
-                yield from assembly_tasks(train_split, grp, cfg,
-                                          batch_size=cfg.batch_size)
+        plan = grouping.grouped_plan(
+            train_split, cfg, batch_size=cfg.batch_size,
+            group_size=group_size, accum=accum > 1, shuffle=True,
+            seed=cfg.seed, epoch=epoch, table=bucket_table,
+            assignment=bucket_assignment)
+        return grouping.grouped_assembly_tasks(
+            train_split, plan, cfg, batch_size=cfg.batch_size,
+            bucketed=bucket_table is not None)
 
     # Aggregated feeder stats across epochs (each epoch gets a fresh
     # pipeline; sums/mins fold here for TrainResult)
@@ -394,8 +418,10 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                         _materialize(last_metrics["loss"])
                     sync_tick()
                     meter.pause()  # dev time is not train time
-                    cur_bleu, dev_text = run_dev(dev_step, state.params, dataset,
-                                                 cfg, var_maps, guard=guard)
+                    cur_bleu, dev_text = run_dev(dev_step, state.params,
+                                                 dataset, cfg, var_maps,
+                                                 guard=guard,
+                                                 eval_plan=dev_plan)
                     better = cur_bleu > best_bleu
                     log.gate(epoch, idx, cur_bleu, better)
                     if better:
@@ -404,32 +430,38 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                         log.dev_output(dev_text)
                     meter.start()
 
-                if profile_window and global_step == profile_window[0]:
+                if (profile_window and not profiling_active
+                        and not profile_done
+                        and global_step >= profile_window[0]):
+                    # the REAL program is profiled — grouped dispatches and
+                    # all — so profiled numbers are production-path numbers;
+                    # a K-group's annotation spans its whole scan dispatch
                     jax.profiler.start_trace(profile_dir)
                     profiling_active = True
-                if profiling_active:  # fused==1 here (forced above)
+                dispatch = grouped_step if stacked else train_step
+                if profiling_active:
                     with profiling.step_annotation(global_step):
-                        state, metrics = train_step(state, batch)
-                    if global_step == profile_window[-1]:
-                        _materialize(metrics["loss"])
-                        jax.profiler.stop_trace()
-                        profiling_active = False
-                        log.console(f"profile trace written to {profile_dir}")
-                elif stacked:
-                    state, metrics = grouped_step(state, batch)
+                        state, metrics = dispatch(state, batch)
                 else:
-                    state, metrics = train_step(state, batch)
+                    state, metrics = dispatch(state, batch)
                 if guard is not None:
                     # compile-once contract: a post-warmup dispatch of any
                     # program that recompiles raises RetraceError here; a
-                    # bucketed item carries its geometry tag, giving each
-                    # bucket's pre-warmed program its own label
+                    # bucketed item carries its geometry tag and a stacked
+                    # item its group size, giving each (geom, K) member of
+                    # the pre-warmed family its own label
                     tag = item.host.get("_tag")
-                    guard.step(f"train_step[{tag}]" if tag
-                               else ("grouped_step" if stacked
-                                     else "train_step"))
+                    guard.step(sanitizer_label(
+                        "grouped_step" if stacked else "train_step", tag,
+                        group_size if stacked else 1))
                 # a fused group is k steps; an accumulation group is ONE step
                 global_step += 1 if (stacked and accum > 1) else k
+                if profiling_active and global_step > profile_window[-1]:
+                    _materialize(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    profiling_active = False
+                    profile_done = True
+                    log.console(f"profile trace written to {profile_dir}")
                 last_metrics = metrics
                 pending_commits += n_valid
                 if log_due:
@@ -461,7 +493,7 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
         log.console(f"profile trace written to {profile_dir}")
     elif profile_dir and not profile_window:
         log.console("profile trace NOT written: profile_steps=0")
-    elif profile_dir and global_step <= profile_window[0]:
+    elif profile_dir and not profile_done:
         log.console(f"profile trace NOT written: run ended after "
                     f"{global_step} steps, before the profile window "
                     f"(starts at step {profile_window[0]})")
@@ -494,4 +526,4 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                        epochs_run=max(0, n_epochs - start_epoch),
                        commits_per_sec_per_chip=cps,
                        feed_stall_frac=msum["feed_stall_frac"],
-                       feeder=feeder_stats)
+                       feeder=feeder_stats, warnings=warnings)

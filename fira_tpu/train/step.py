@@ -99,7 +99,11 @@ def make_multi_step(model: FiraModel, cfg: FiraConfig
 
 
 def stack_batches(batches) -> Dict[str, Any]:
-    """Stack host batches along a new leading axis for make_multi_step."""
+    """Stack host batches along a new leading axis for make_multi_step /
+    make_accum_step. The batches must share one geometry — under buckets
+    the grouped scheduler guarantees bucket-homogeneous groups, and its
+    ``data.grouping.stack_group`` owns the accum-tail variant that pads
+    short groups with all-invalid micro-batches."""
     import numpy as np
 
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
@@ -220,7 +224,14 @@ def jit_train_step(model: FiraModel, cfg: FiraConfig, mesh: Optional[Mesh],
 def jit_multi_step(model: FiraModel, cfg: FiraConfig, mesh: Optional[Mesh],
                    state: TrainState, stacked_sample) -> Callable:
     """Compile the K-step device loop; with a mesh, batches shard along
-    their SECOND axis (leading axis is the scan/step axis)."""
+    their SECOND axis (leading axis is the scan/step axis).
+
+    Per-BucketGeom specialization falls out of jit's shape cache: the ONE
+    returned callable compiles one program per stacked input shape, i.e.
+    one per (geometry, K) family member — NamedShardings constrain layout,
+    not shape, so the mesh path needs no per-geometry re-wrapping. The
+    train loop pre-warms every member on a throwaway state
+    (train/loop.py), so the epoch loop never compiles."""
     return _jit_stacked(make_multi_step(model, cfg), mesh, state,
                         stacked_sample)
 
@@ -228,7 +239,11 @@ def jit_multi_step(model: FiraModel, cfg: FiraConfig, mesh: Optional[Mesh],
 def jit_accum_step(model: FiraModel, cfg: FiraConfig, mesh: Optional[Mesh],
                    state: TrainState, stacked_sample) -> Callable:
     """Compile the A-micro-batch accumulation step (same stacked layout as
-    the device loop: leading axis = micro-batch, second axis = batch/data)."""
+    the device loop: leading axis = micro-batch, second axis = batch/data;
+    same per-(geometry, A) shape-cache specialization as jit_multi_step).
+    Bucketed accum tails keep the stacked shape — the scheduler pads short
+    groups with all-invalid micro-batches (data/grouping.py) — so A is the
+    only leading dim ever compiled."""
     return _jit_stacked(make_accum_step(model, cfg), mesh, state,
                         stacked_sample)
 

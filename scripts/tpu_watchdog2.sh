@@ -18,6 +18,13 @@ for i in $(seq 1 600); do
         timeout 1400 python "$job" >> "$LOG" 2>&1
         echo "[watchdog2] $job rc=$? $(date -u +%FT%TZ)" >> "$LOG"
       done
+      # DECODE_PERF_KNOBS bracket rows (VERDICT r5 item 5): the decode
+      # bench's kv/factored/early-exit rows at production batch sizes —
+      # batch 170 ran in the job loop above; 512 is the production-geometry
+      # bracket that decides whether the set graduates into the defaults.
+      echo "[watchdog2] running decode bracket DECODE_BATCH=512 $(date -u +%FT%TZ)" >> "$LOG"
+      DECODE_BATCH=512 timeout 1400 python scripts/tpu_decode_bench.py >> "$LOG" 2>&1
+      echo "[watchdog2] decode bracket rc=$? $(date -u +%FT%TZ)" >> "$LOG"
       echo "[watchdog2] running bench.py $(date -u +%FT%TZ)" >> "$LOG"
       FIRA_BENCH_PROBE_BUDGET=120 timeout 1200 python bench.py >> "$LOG" 2>&1
       echo "[watchdog2] bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"

@@ -62,3 +62,13 @@ def test_bench_harness_cpu_success():
     assert result["step_time_s"] > 0
     assert result["flops_per_step"] > 0
     assert result["overrides"] == {"sort_edges": True}
+    # the composed production leg (stacked knobs x buckets) rides on every
+    # success record with its dispatch-count + padding accounting
+    comp = result["composed"]
+    assert "error" not in comp, comp
+    assert result["value_composed"] == comp["value"] > 0
+    assert comp["dispatches"] == (comp["grouped_dispatches"]
+                                  + comp["per_step_dispatches"])
+    assert comp["commits"] > 0 and comp["steps_dispatched"] > 0
+    assert 0.0 <= comp["padding_frac_dispatched"] < 1.0
+    assert comp["buckets"]

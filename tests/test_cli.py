@@ -9,7 +9,7 @@ import os
 import numpy as np
 
 from fira_tpu import cli
-from fira_tpu.config import PRODUCTION_PERF_KNOBS
+from fira_tpu.config import DECODE_PERF_KNOBS, PRODUCTION_PERF_KNOBS
 
 
 def _cfg(argv):
@@ -32,6 +32,15 @@ def test_production_preset_is_valid_and_applies():
     cfg = _cfg(["train", "--perf", "production"])
     for k, v in PRODUCTION_PERF_KNOBS.items():
         assert getattr(cfg, k) == v, k
+    # the decode-side set rides the same preset (VERDICT r5 item 5:
+    # equivalence pinned by tests/test_beam_early_exit.py; TPU bracket
+    # rows queued in scripts/tpu_watchdog2.sh)
+    for k, v in DECODE_PERF_KNOBS.items():
+        assert getattr(cfg, k) == v, k
+    # parity defaults stay parity: early exit / factored top-k off
+    base = _cfg(["test"])
+    assert base.beam_early_exit is False
+    assert base.beam_factored_topk is False
 
 
 def test_explicit_flag_overrides_preset():
