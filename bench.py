@@ -119,7 +119,12 @@ device loop, sorted scatters, bf16 residual streams, no copy-head remat
 FIRA_BENCH_OVERRIDES (JSON FiraConfig fields, wins over both),
 FIRA_BENCH_COMPOSED=0 (skip the composed leg), FIRA_BENCH_COMPOSED_DATA
 (corpus size for the composed leg; default 3*K*batch so each auto bucket
-can fill K-groups).
+can fill K-groups),
+FIRA_BENCH_DECODE_ENGINE=1 (opt-in decode leg: slot-refill continuous-
+batching engine vs the batched early-exit beam on the same 3-batch
+eos-biased stream — decode/engine.py; the watchdog harvest sets it),
+FIRA_BENCH_DECODE_EOS_DELTA (default 4.75 — the mixed-settle EOS bias of
+that leg's paramset).
 
 Composed leg — the production path going forward (ISSUE 4): the stacked
 knobs AND the auto bucket table together. One shuffled epoch plan of
@@ -636,6 +641,93 @@ def worker() -> None:
         except Exception as e:
             print(f"composed leg failed: {e!r}", file=sys.stderr)
             composed = {"error": repr(e)}
+    # (e) DECODE-ENGINE leg (opt-in: FIRA_BENCH_DECODE_ENGINE=1 — the
+    # watchdog harvest sets it): slot-refill continuous-batching decode
+    # (decode/engine.py) vs the batched early-exit beam on the SAME
+    # 3-batch stream and eos-biased paramset (mixed settle depths — the
+    # realistic regime where the batch path pays the per-batch max and the
+    # engine pays the mean). Reported next to the train legs so one bench
+    # record carries both sides; failures degrade to a structured error.
+    # Measurement protocol (warm + stats reset + timed drive, batched twin
+    # with per-batch np.asarray harvest sync) must stay in lockstep with
+    # scripts/tpu_decode_bench.py's engine_row/batch_early_exit_row.
+    decode_engine = None
+    if os.environ.get("FIRA_BENCH_DECODE_ENGINE", "0") == "1":
+        try:
+            from fira_tpu.data.feeder import Feeder
+            from fira_tpu.decode import engine as engine_lib
+            from fira_tpu.decode.beam import (eos_biased_params,
+                                              make_beam_search)
+
+            eos_delta = float(os.environ.get(
+                "FIRA_BENCH_DECODE_EOS_DELTA", "4.75"))
+            cfg_dec = cfg.replace(test_batch_size=batch_size,
+                                  beam_kv_cache=True,
+                                  beam_factored_topk=False)
+            params_dec = eos_biased_params(state_box[0].params,
+                                           delta=eos_delta)
+            dec_chunks = [rng.choice(n_data, batch_size, replace=True)
+                          for _ in range(3)]
+            n_dec = batch_size * len(dec_chunks)
+
+            # both sides pay the SAME input pipeline (assembly + H2D via
+            # the async Feeder, inside the timed window) — the speedup
+            # compares decode strategies, not batch pre-staging
+            def dec_tasks():
+                for ix in dec_chunks:
+                    yield (lambda ix=ix: make_batch(split, ix, cfg_dec))
+
+            cfgb = cfg_dec.replace(beam_early_exit=True)
+            beam_b = make_beam_search(
+                FiraModel(cfgb, dtype=jnp.dtype(dtype)), cfgb,
+                with_steps=True)
+            warm_b = jax.device_put(make_batch(split, dec_chunks[0], cfgb))
+            jax.block_until_ready(warm_b)
+            out = beam_b(params_dec, warm_b)
+            _ = np.asarray(out[0])          # compile + honest sync
+            t0 = time.perf_counter()
+            batch_steps = 0
+            with Feeder(dec_tasks(), num_workers=cfg.feeder_workers,
+                        depth=cfg.feeder_depth) as dec_feed:
+                for dec_item in dec_feed:
+                    out = beam_b(params_dec, dec_item.device)
+                    batch_steps += int(out[2])  # per-batch harvest sync
+                    _ = np.asarray(out[0])
+            dt_batch = time.perf_counter() - t0
+
+            model_dec = FiraModel(cfg_dec, dtype=jnp.dtype(dtype))
+            eng = engine_lib.SlotEngine(model_dec, params_dec, cfg_dec)
+
+            def drive():
+                tasks = ((lambda ix=ix: make_batch(split, ix, cfg_dec))
+                         for ix in dec_chunks)
+                with Feeder(tasks, num_workers=cfg.feeder_workers,
+                            depth=cfg.feeder_depth) as feed:
+                    for _item in eng.run(feed):
+                        pass
+
+            drive()                          # compiles prefill/step/insert
+            eng.stats = engine_lib.EngineStats(slots=eng.slots)
+            t0 = time.perf_counter()
+            drive()
+            dt_eng = time.perf_counter() - t0
+            st = eng.stats.summary()
+            decode_engine = {
+                "value_engine": round(st["commits"] / dt_eng / n_chips, 2),
+                "value_early_exit": round(n_dec / dt_batch / n_chips, 2),
+                "speedup": round((st["commits"] / dt_eng)
+                                 / (n_dec / dt_batch), 3),
+                "unit": UNIT,
+                "eos_delta": eos_delta,
+                "early_exit_steps_run": batch_steps,
+                **{k: st[k] for k in ("slots", "slot_occupancy",
+                                      "steps_run", "refills",
+                                      "steps_per_commit", "dispatches")},
+            }
+        except Exception as e:
+            print(f"decode engine leg failed: {e!r}", file=sys.stderr)
+            decode_engine = {"error": repr(e)}
+
     step_time = dt_e2e / steps_per_window
     compute_step_time = dt_compute / steps_per_window
     # metric of record: chip-side throughput (see module docstring "History
@@ -681,6 +773,9 @@ def worker() -> None:
         # plus dispatch-count + dispatched-padding accounting
         **({"value_composed": composed.get("value"),
             "composed": composed} if composed else {}),
+        # slot-refill engine decode vs batched early exit on the same
+        # stream (FIRA_BENCH_DECODE_ENGINE=1; decode/engine.py)
+        **({"decode_engine": decode_engine} if decode_engine else {}),
         "feed_stall_frac_sync_assembly": sync_info["feed_stall_frac"],
         "value_e2e_sync_assembly": round(
             batch_size / (dt_sync / steps_per_window) / n_chips, 2),

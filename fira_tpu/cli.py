@@ -76,6 +76,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "emitted EOS (+1 settling step) — bit-exact vs the "
                         "full tar_len scan, wall clock scales with the "
                         "batch's longest message")
+    p.add_argument("--engine", action="store_true",
+                   help="test: decode through the slot-refill continuous-"
+                        "batching engine (decode/engine.py, docs/"
+                        "DECODE_ENGINE.md): settled slots are harvested "
+                        "and refilled mid-flight, so wall clock scales "
+                        "with total tokens emitted instead of per-batch "
+                        "max length. Bit-exact per sample vs the batched "
+                        "beam (pinned by tests) in every kv-cache x "
+                        "factored-topk mode")
+    p.add_argument("--engine-slots", type=_positive, default=None,
+                   metavar="S",
+                   help="test: engine slot-arena size (default: "
+                        "--test-batch-size — equal geometry with the "
+                        "batched beam)")
+    p.add_argument("--engine-prefill-depth", type=_positive, default=None,
+                   metavar="D",
+                   help="test: prefilled chunks staged ahead of the "
+                        "engine's refill loop (default 2; 1 = prefill "
+                        "strictly on demand)")
+    p.add_argument("--engine-harvest-every", type=_positive, default=None,
+                   metavar="R",
+                   help="test: engine harvest cadence — beam positions "
+                        "advanced per step dispatch before the host "
+                        "harvests settled slots (default 4; output-"
+                        "identical for any R, pinned by tests)")
     p.add_argument("--beam-log-space", action="store_true",
                    help="log-space beam accumulation instead of the "
                         "reference-compat probability space")
@@ -151,8 +176,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "rbg dropout PRNG, fused device loop, sorted "
                         "scatters, bf16 residual streams, no copy-head "
                         "remat — docs/PERF.md) plus the equivalence-pinned "
-                        "beam set (config.DECODE_PERF_KNOBS: kv cache, "
-                        "factored top-k, early exit); 'parity' (default) "
+                        "decode set (config.DECODE_PERF_KNOBS: kv cache, "
+                        "factored top-k, early exit, slot-refill engine "
+                        "decode); 'parity' (default) "
                         "keeps the reference-parity knob defaults. "
                         "Individual flags override the preset either way")
     return p
@@ -184,6 +210,14 @@ def _resolve_cfg(args):
         overrides["beam_factored_topk"] = True
     if args.beam_early_exit:
         overrides["beam_early_exit"] = True
+    if args.engine:
+        overrides["decode_engine"] = True
+    if args.engine_slots is not None:
+        overrides["engine_slots"] = args.engine_slots
+    if args.engine_prefill_depth is not None:
+        overrides["engine_prefill_depth"] = args.engine_prefill_depth
+    if args.engine_harvest_every is not None:
+        overrides["engine_harvest_every"] = args.engine_harvest_every
     if args.adjacency:
         overrides["adjacency_impl"] = args.adjacency
     if args.encoder_buffer:

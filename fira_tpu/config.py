@@ -140,6 +140,37 @@ class FiraConfig:
     # kv-cache x factored-topk modes by tests/test_beam_early_exit.py.
     beam_early_exit: bool = False
 
+    # --- continuous-batching decode engine (decode/engine.py) ---
+    # True routes run_test through the slot-refill engine: S static slots
+    # each advance their own beam one token per step program; EOS-settled
+    # slots are harvested and refilled mid-flight from the packer stream,
+    # so decode wall clock scales with TOTAL tokens emitted instead of
+    # per-batch max length (Orca/vLLM iteration-level batching under this
+    # stack's static shapes — docs/DECODE_ENGINE.md). Output is bit-exact
+    # per sample vs the batched beam in all four kv-cache x factored-topk
+    # modes (tests/test_engine.py).
+    decode_engine: bool = False
+    # Slot count S (the engine's fixed arena). 0 = test_batch_size: equal
+    # geometry with the batched beam — the apples-to-apples default the
+    # golden tests pin.
+    engine_slots: int = 0
+    # Prefilled chunks staged ahead of the refill loop (each holds one
+    # packed batch's encoder outputs on device): 1 = prefill strictly on
+    # demand; higher overlaps the next chunk's encoder work with the step
+    # loop at O(depth * chunk encoder state) extra device memory.
+    engine_prefill_depth: int = 2
+    # Harvest cadence R: each step dispatch advances live slots R beam
+    # positions (a lax.scan of identical one-step bodies) before the host
+    # harvests/refills. Slots that settle mid-scan self-mask out, so the
+    # cadence changes WHICH dispatch a harvest lands in, never the output
+    # (pinned by tests/test_engine.py). R divides per-dispatch overhead
+    # (dispatch latency + the done-mask readback sync + insert dispatch
+    # coalescing) by R at the cost of settled slots idling up to R-1
+    # micro-steps before refill — the R=4 default measures fastest on the
+    # CPU length-mix bench (scripts/tpu_decode_bench.py engine_mixed row)
+    # and the occupancy loss shows up honestly in slot_occupancy.
+    engine_harvest_every: int = 4
+
     # --- typed edges (beyond-parity extension) ---
     # The reference computes six edge families then flattens them into one
     # untyped adjacency (process_edge's `kind` is dead, Dataset.py:346-357;
@@ -315,6 +346,12 @@ DECODE_PERF_KNOBS = {
     "beam_kv_cache": True,
     "beam_factored_topk": True,
     "beam_early_exit": True,
+    # Slot-refill continuous batching (decode/engine.py): run_test decodes
+    # through the S-slot engine — per-sample bit-exact vs the batched beam
+    # (tests/test_engine.py), wall clock scales with total tokens emitted.
+    # engine_slots/engine_prefill_depth keep their config defaults (slots
+    # = test_batch_size).
+    "decode_engine": True,
 }
 
 

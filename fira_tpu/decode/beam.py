@@ -91,7 +91,13 @@ def _selection_tail(cand, ids, tokens, probs, finished, s, batch,
     ids: None when W is the fused output space itself (token id = index
     within the beam's W); else a (B, K, W) table of fused-space ids to
     gather the chosen token from (the factored path's per-side top-k
-    candidates)."""
+    candidates).
+
+    ``s`` may be a scalar (every row at the same position — the batch beam
+    scan) or a (B,) vector (each row at its OWN position — the slot-refill
+    engine, decode/engine.py, whose slots hold samples mid-flight at mixed
+    depths). The two forms run the identical per-row math: the vector path
+    only swaps the shared s+1 column write for a per-row gather/scatter."""
     B, K, W = cand.shape
     cand = jnp.where(finished[:, :, None], neg, cand)
     sentinel = jnp.where(finished, probs, neg)          # (B, K)
@@ -109,10 +115,22 @@ def _selection_tail(cand, ids, tokens, probs, finished, s, batch,
     tok = _resolve_copy(tok, batch["diff"], batch["sub_token"], cfg)
 
     new_tokens = jnp.take_along_axis(tokens, src_beam[:, :, None], axis=1)
-    keep = new_tokens[:, :, s + 1]  # finished beams keep their padding
-    new_tokens = new_tokens.at[:, :, s + 1].set(
-        jnp.where(is_sent, keep, tok)
-    )
+    if jnp.ndim(s) == 0:
+        keep = new_tokens[:, :, s + 1]  # finished beams keep their padding
+        new_tokens = new_tokens.at[:, :, s + 1].set(
+            jnp.where(is_sent, keep, tok)
+        )
+    else:
+        # per-row position: row b writes its own column s[b]+1 (clamped
+        # rows — engine slots already done/idle — are blended away by the
+        # caller, so their garbage write never lands in live state)
+        b_idx = jnp.arange(B)[:, None]
+        k_idx = jnp.arange(K)[None, :]
+        sp1 = (s + 1)[:, None]
+        keep = new_tokens[b_idx, k_idx, sp1]
+        new_tokens = new_tokens.at[b_idx, k_idx, sp1].set(
+            jnp.where(is_sent, keep, tok)
+        )
     new_finished = jnp.where(is_sent, True, tok == EOS_ID)
     return new_tokens, top_vals, new_finished, src_beam
 

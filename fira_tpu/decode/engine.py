@@ -1,0 +1,537 @@
+"""Slot-refill continuous-batching decode engine.
+
+The batched beam (decode/beam.py) dispatches whole batches: even with
+``beam_early_exit`` the while_loop runs until the batch's LONGEST message
+settles, so on real corpora (mean message ~8-10 tokens against the
+tar_len-1 = 29 step budget) most rows of a dispatch are finished beams
+burning device cycles. This module applies iteration-level continuous
+batching (Orca, OSDI '22) under this stack's static-shape regime (slots as
+a fixed-geometry KV arena, vLLM SOSP '23 — PAPERS.md "Continuous batching
+/ inference serving"): a fixed arena of S slots, each holding one
+sample's beam mid-flight at its OWN decode depth, advanced one token per
+step program; settled slots are harvested and refilled with freshly
+prefilled requests, so wall clock scales with TOTAL TOKENS EMITTED, not
+with per-batch max length.
+
+Program family (all fixed-shape, labelled for the compile guard —
+``engine_prefill[<geom>]`` x the decode bucket table, ``engine_step``,
+``engine_insert``; zero post-warmup retraces):
+
+- **prefill** (one per decode bucket geometry): encoder forward + per-beam
+  cross-attention K/V + copy-head source projection for ONE packed batch
+  of new requests — exactly the per-batch preamble of the batched beam, on
+  exactly the batches the existing bucketed/sorted packer emits (the
+  feeder assembles and ships them asynchronously, as for every driver).
+- **step** (single geometry — the bucketable axes never reach the decoder:
+  ``sou_len``/``sub_token_len`` are pinned by the copy-label id space and
+  decode pins ``tar_len`` full): advance every live slot's beam
+  ``cfg.engine_harvest_every`` positions at the slot's own depth
+  (model.dist_parts_step_multi / fused_probs_step_multi; the per-row
+  ``s`` vector path of beam._selection_tail), with a per-slot
+  finished/done mask instead of the batch path's global early-exit
+  predicate. Idle/done slots compute garbage that is blended away — they
+  are the occupancy loss the refill loop exists to keep near zero.
+- **insert**: scatter up to one prefilled chunk's rows into freed slots
+  (slot ids are data, not shapes: a (C,) vector with the out-of-range
+  sentinel S marking rows not consumed this call, ``mode="drop"``).
+
+Equivalence contract (pinned by tests/test_engine.py in all four
+kv-cache x factored-topk modes): per sample, the engine's (tokens, probs)
+are BIT-EXACT equal to the batched beam's. The argument has three legs:
+
+1. beam search is per-sample independent — every batched-beam op acts
+   row-wise (embeds, per-row matmuls, attention over the row's own
+   sequence, per-row top-k), so a sample's trajectory does not depend on
+   its batch neighbours (the test_batch_size knob already rides on this);
+2. the step program runs the SAME selection math at a per-row position
+   vector (beam._selection_tail treats scalar and vector ``s``
+   identically per row), against the same prefill values the batched
+   beam computes (same packed batches, same encode/decode_init program
+   prefix);
+3. per-slot termination replicates the early-exit predicate exactly —
+   done = all-finished-before-step AND all-finished-after (the settling
+   step that re-sorts beams), or position exhausted — and
+   tests/test_beam_early_exit.py already pins that stopping there equals
+   running the full scan.
+
+Host scheduler (:meth:`SlotEngine.run`): drains the packer stream via the
+async feeder, prefills ahead (``cfg.engine_prefill_depth`` chunks),
+refills every freed slot, steps, harvests settled slots, and yields one
+:class:`EngineItem` per sample AS IT SETTLES (out of split order — the
+ordered streaming writer, decode/stream.py, restores order on disk). The
+per-dispatch ``done`` readback is the engine's designated sync boundary:
+the refill decision is host-side by construction.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fira_tpu.analysis.sanitizer import program_label
+from fira_tpu.config import FiraConfig
+from fira_tpu.decode.beam import _init_beam, _select, _select_factored
+from fira_tpu.model.model import FiraModel
+
+PREFILL_KIND = "engine_prefill"
+STEP_LABEL = "engine_step"
+INSERT_LABEL = "engine_insert"
+
+
+@dataclasses.dataclass
+class EngineStats:
+    """Dispatch/occupancy accounting for one engine run."""
+
+    slots: int
+    prefills: int = 0            # prefill program dispatches (chunks)
+    refills: int = 0             # insert program dispatches
+    slots_refilled: int = 0      # slot fills across all inserts
+    steps: int = 0               # beam MICRO-steps run (cadence x dispatches)
+    step_dispatches: int = 0     # step program dispatches
+    occupied_slot_steps: int = 0  # exact count of (slot, micro-step) pairs
+                                  # that did real beam work (device-counted)
+    commits: int = 0             # samples harvested
+
+    @property
+    def slot_occupancy(self) -> float:
+        """Mean fraction of slots doing real beam work per micro-step."""
+        total = self.steps * self.slots
+        return self.occupied_slot_steps / total if total else 0.0
+
+    @property
+    def steps_per_commit(self) -> float:
+        return self.steps / self.commits if self.commits else 0.0
+
+    @property
+    def dispatches(self) -> int:
+        return self.prefills + self.refills + self.step_dispatches
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "slots": self.slots,
+            "prefills": self.prefills,
+            "refills": self.refills,
+            "slots_refilled": self.slots_refilled,
+            "steps_run": self.steps,
+            "step_dispatches": self.step_dispatches,
+            "commits": self.commits,
+            "dispatches": self.dispatches,
+            "slot_occupancy": round(self.slot_occupancy, 4),
+            "steps_per_commit": round(self.steps_per_commit, 3),
+        }
+
+
+@dataclasses.dataclass
+class EngineItem:
+    """One settled sample: the per-sample view of the batched beam's
+    output — ``tokens[argmax(probs)]`` is the prediction, copy ids already
+    resolved at extension time (identical contract to decode/beam.py)."""
+
+    position: int        # split-local sample position (output order key)
+    host: Dict           # the host batch this sample rode in on
+    row: int             # its row within that batch (indexes host fields)
+    tokens: np.ndarray   # (beam, tar_len) int32
+    probs: np.ndarray    # (beam,) float32
+
+
+@dataclasses.dataclass
+class _Staged:
+    """A prefilled chunk whose rows are not all inserted yet."""
+
+    chunk: Dict                  # device pytree from the prefill program
+    host: Dict                   # host batch (text-cooking fields + meta)
+    rows: "collections.deque[Tuple[int, int]]"  # (row, split position)
+
+
+class SlotEngine:
+    """S-slot continuous-batching beam decoder over one model/params.
+
+    ``slots``: arena size (default ``cfg.engine_slots`` or, when that is 0,
+    ``cfg.test_batch_size`` — equal geometry with the batched beam, which
+    is also what the bit-exactness golden tests pin). ``guard``: an armed
+    analysis.sanitizer.CompileGuard; every dispatch is labelled, so the
+    one-compile-per-label contract covers the whole engine family.
+    """
+
+    def __init__(self, model: FiraModel, params, cfg: FiraConfig, *,
+                 slots: Optional[int] = None, guard=None):
+        self.model = model
+        self.params = params
+        self.cfg = cfg
+        self.slots = int(slots or cfg.engine_slots or cfg.test_batch_size)
+        if self.slots < 1:
+            raise ValueError(f"engine needs >= 1 slot, got {self.slots}")
+        self.guard = guard
+        self.stats = EngineStats(slots=self.slots)
+        self._state = None
+        self._prefill = jax.jit(self._prefill_fn)
+        # the big slot arena is donated through step/insert: the engine
+        # holds exactly one live state, rebound on every dispatch
+        self._step = jax.jit(self._step_fn, donate_argnums=(1,))
+        self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
+
+    # --- jitted programs -------------------------------------------------
+
+    def _prefill_fn(self, params, batch):
+        """Per-batch preamble of the batched beam, verbatim: encode once,
+        then (kv mode) per-layer cross K/V + copy-head source projection
+        replicated per beam, or (full-redecode mode) the per-beam encoder
+        states themselves. Identical program prefix => identical values."""
+        cfg, model = self.cfg, self.model
+        K = cfg.beam_size
+        states, mask = model.apply({"params": params}, batch,
+                                   method=FiraModel.encode)
+        out = {"src_mask": mask, "diff": batch["diff"],
+               "sub_token": batch["sub_token"]}
+        if cfg.beam_kv_cache:
+            cross_k, cross_v, src_proj = model.apply(
+                {"params": params}, states, method=FiraModel.decode_init)
+            out["cross_k"] = jnp.repeat(cross_k, K, axis=1)
+            out["cross_v"] = jnp.repeat(cross_v, K, axis=1)
+            out["src_proj"] = jnp.repeat(src_proj, K, axis=0)
+            # dtype marker only: fresh slots seed their self-attention
+            # cache at zeros of the ENCODER STATE dtype, exactly like the
+            # batched beam's cache0 (which may be wider than the compute
+            # dtype under stable_residual)
+            out["cache_seed"] = jnp.zeros((), states.dtype)
+        else:
+            out["states"] = jnp.repeat(states, K, axis=0)
+        return out
+
+    def _step_fn(self, params, state):
+        """Advance every live, not-yet-done slot ``cfg.engine_harvest_every``
+        beam positions at its own depth (a lax.scan of identical one-step
+        bodies — slots that settle mid-scan self-mask out, so the cadence
+        changes WHICH dispatch a harvest lands in, never the math);
+        everything else passes through unchanged. Returns (state,
+        occupied-slot-step count) — the occupancy numerator, counted
+        exactly, micro-step by micro-step."""
+        R = max(1, int(self.cfg.engine_harvest_every))
+        if R == 1:
+            return self._one_step(params, state)
+
+        def body(carry, _):
+            st, acc = carry
+            st, occ = self._one_step(params, st)
+            return (st, acc + occ), None
+
+        (state, occ), _ = jax.lax.scan(
+            body, (state, jnp.int32(0)), None, length=R)
+        return state, occ
+
+    def _one_step(self, params, state):
+        """One beam position for every live, not-yet-done slot."""
+        cfg, model = self.cfg, self.model
+        S, K, T = self.slots, cfg.beam_size, cfg.tar_len
+        L, H = cfg.num_layers, cfg.num_head
+        d_head = cfg.embedding_dim // H
+        neg = (jnp.float32(-1.0) if cfg.beam_compat_prob_space
+               else jnp.float32(-np.inf))
+
+        tokens, probs, finished = (state["tokens"], state["probs"],
+                                   state["finished"])
+        pos = state["pos"]
+        active = state["live"] & ~state["done"]
+        # idle/done rows clamp to a legal position; their computation is
+        # garbage by construction and blended away below
+        pos_c = jnp.minimum(pos, T - 2)
+        flat = tokens.reshape(S * K, T)
+        pos_bk = jnp.repeat(pos_c, K)
+        mask_k = jnp.repeat(state["src_mask"], K, axis=0)
+        slot_src = {"diff": state["diff"], "sub_token": state["sub_token"]}
+        all_fin_before = jnp.all(finished, axis=1)   # (S,)
+
+        out_caches = {}
+        if cfg.beam_kv_cache:
+            # same per-row validity rule as beam_search_cached, at the
+            # per-slot position vector
+            valid = (flat != 0).at[:, 0].set(True) & (
+                jnp.arange(T)[None, :] <= pos_bk[:, None])
+            tok_in = jnp.take_along_axis(flat, pos_bk[:, None], axis=1)
+            if cfg.beam_factored_topk:
+                gen, copy, gate, k_cache, v_cache = model.apply(
+                    {"params": params}, mask_k, tok_in, pos_bk,
+                    state["k_cache"], state["v_cache"],
+                    state["cross_k"], state["cross_v"], state["src_proj"],
+                    valid[:, None, None, :],
+                    method=FiraModel.dist_parts_step_multi,
+                )
+                new_tokens, new_probs, new_finished, src_beam = \
+                    _select_factored(
+                        gen[:, 0, :].reshape(S, K, -1),
+                        copy[:, 0, :].reshape(S, K, -1),
+                        gate[:, 0, :].reshape(S, K, 2),
+                        tokens, probs, finished, pos_c, slot_src, cfg, neg)
+            else:
+                fused, k_cache, v_cache = model.apply(
+                    {"params": params}, mask_k, tok_in, pos_bk,
+                    state["k_cache"], state["v_cache"],
+                    state["cross_k"], state["cross_v"], state["src_proj"],
+                    valid[:, None, None, :],
+                    method=FiraModel.fused_probs_step_multi,
+                )
+                dist = fused[:, 0, :].reshape(S, K, -1)
+                new_tokens, new_probs, new_finished, src_beam = _select(
+                    dist, tokens, probs, finished, pos_c, slot_src, cfg, neg)
+            # permute cached histories to follow their beams (exactly the
+            # batched beam's gather). Inactive rows are NOT blended back:
+            # a done/idle slot's cache is never read again — it is not
+            # stepped, and a refill overwrites its cache rows wholesale
+            # (insert zeroes k/v, rewrites cross/src) — so letting the
+            # step scribble on it saves two full-cache select passes per
+            # micro-step. tokens/probs/finished/pos DO blend below: they
+            # must survive until harvest.
+            idx = src_beam[None, :, :, None, None, None]
+
+            def gather_cache(c):
+                c = c.reshape(L, S, K, H, T, d_head)
+                c = jnp.take_along_axis(c, idx, axis=2)
+                return c.reshape(L, S * K, H, T, d_head)
+
+            out_caches["k_cache"] = gather_cache(k_cache)
+            out_caches["v_cache"] = gather_cache(v_cache)
+        else:
+            tar_mask = (flat != 0).at[:, 0].set(True)
+
+            def at_pos(a):  # row b's own position out of the full-prefix decode
+                return jnp.take_along_axis(
+                    a, pos_bk[:, None, None], axis=1)[:, 0, :]
+
+            if cfg.beam_factored_topk:
+                gen, copy, gate = model.apply(
+                    {"params": params}, state["states"], mask_k, flat,
+                    tar_mask, method=FiraModel.dist_parts)
+                new_tokens, new_probs, new_finished, _ = _select_factored(
+                    at_pos(gen).reshape(S, K, -1),
+                    at_pos(copy).reshape(S, K, -1),
+                    at_pos(gate).reshape(S, K, 2),
+                    tokens, probs, finished, pos_c, slot_src, cfg, neg)
+            else:
+                fused = model.apply(
+                    {"params": params}, state["states"], mask_k, flat,
+                    tar_mask, method=FiraModel.fused_probs)
+                dist = at_pos(fused).reshape(S, K, -1)
+                new_tokens, new_probs, new_finished, _ = _select(
+                    dist, tokens, probs, finished, pos_c, slot_src, cfg, neg)
+
+        tokens = jnp.where(active[:, None, None], new_tokens, tokens)
+        probs = jnp.where(active[:, None], new_probs, probs)
+        finished = jnp.where(active[:, None], new_finished, finished)
+        new_pos = jnp.where(active, pos + 1, pos)
+        all_fin_after = jnp.all(finished, axis=1)
+        # the early-exit predicate, per slot: stopping is exact once the
+        # settling step has re-sorted an all-finished beam set
+        # (decode/beam._run_steps; tests/test_beam_early_exit.py), or when
+        # the position budget is exhausted
+        done = state["done"] | (active & ((new_pos >= T - 1)
+                                          | (all_fin_before & all_fin_after)))
+        return (dict(state, tokens=tokens, probs=probs, finished=finished,
+                     pos=new_pos, done=done, **out_caches),
+                jnp.sum(active.astype(jnp.int32)))
+
+    def _insert_fn(self, state, chunk, slot_ids):
+        """Scatter chunk rows into slots. ``slot_ids``: (C,) int32, row j
+        goes to slot ``slot_ids[j]``; the out-of-range sentinel S marks
+        rows NOT consumed by this call (their scatter drops)."""
+        cfg = self.cfg
+        K = cfg.beam_size
+        C = slot_ids.shape[0]
+        tokens0, probs0, finished0, _neg = _init_beam(C, cfg)
+        sid = slot_ids.astype(jnp.int32)
+        sid_bk = jnp.repeat(sid, K) * K + jnp.tile(jnp.arange(K), C)
+
+        new = dict(state)
+
+        def put(field, value):
+            new[field] = state[field].at[sid].set(value, mode="drop")
+
+        put("tokens", tokens0)
+        put("probs", probs0)
+        put("finished", finished0)
+        put("diff", chunk["diff"])
+        put("sub_token", chunk["sub_token"])
+        put("src_mask", chunk["src_mask"])
+        new["pos"] = state["pos"].at[sid].set(0, mode="drop")
+        new["live"] = state["live"].at[sid].set(True, mode="drop")
+        new["done"] = state["done"].at[sid].set(False, mode="drop")
+        if cfg.beam_kv_cache:
+            for f in ("cross_k", "cross_v"):
+                new[f] = state[f].at[:, sid_bk].set(chunk[f], mode="drop")
+            new["src_proj"] = state["src_proj"].at[sid_bk].set(
+                chunk["src_proj"], mode="drop")
+            # fresh slots start from the batched beam's zero cache
+            new["k_cache"] = state["k_cache"].at[:, sid_bk].set(0, mode="drop")
+            new["v_cache"] = state["v_cache"].at[:, sid_bk].set(0, mode="drop")
+        else:
+            new["states"] = state["states"].at[sid_bk].set(
+                chunk["states"], mode="drop")
+        return new
+
+    # --- state ----------------------------------------------------------
+
+    def _ensure_state(self, chunk) -> None:
+        """Allocate the slot arena (all slots dead) from the first chunk's
+        shapes/dtypes. Plain host zeros + one device_put: no compiled
+        program, so nothing for the compile guard to mis-attribute."""
+        if self._state is not None:
+            return
+        cfg = self.cfg
+        S, K, T = self.slots, cfg.beam_size, cfg.tar_len
+        L, H = cfg.num_layers, cfg.num_head
+        d_head = cfg.embedding_dim // H
+        z = {
+            "tokens": np.zeros((S, K, T), np.int32),
+            "probs": np.zeros((S, K), np.float32),
+            "finished": np.zeros((S, K), bool),
+            "pos": np.zeros((S,), np.int32),
+            "live": np.zeros((S,), bool),
+            "done": np.zeros((S,), bool),
+            "diff": np.zeros((S,) + chunk["diff"].shape[1:],
+                             chunk["diff"].dtype),
+            "sub_token": np.zeros((S,) + chunk["sub_token"].shape[1:],
+                                  chunk["sub_token"].dtype),
+            "src_mask": np.zeros((S,) + chunk["src_mask"].shape[1:], bool),
+        }
+        if cfg.beam_kv_cache:
+            ck = chunk["cross_k"]
+            z["cross_k"] = np.zeros((L, S * K) + ck.shape[2:], ck.dtype)
+            z["cross_v"] = np.zeros((L, S * K) + ck.shape[2:], ck.dtype)
+            sp = chunk["src_proj"]
+            z["src_proj"] = np.zeros((S * K,) + sp.shape[1:], sp.dtype)
+            cd = chunk["cache_seed"].dtype
+            z["k_cache"] = np.zeros((L, S * K, H, T, d_head), cd)
+            z["v_cache"] = np.zeros((L, S * K, H, T, d_head), cd)
+        else:
+            st = chunk["states"]
+            z["states"] = np.zeros((S * K,) + st.shape[1:], st.dtype)
+        self._state = jax.device_put(z)
+
+    # --- host scheduler --------------------------------------------------
+
+    def _guard_step(self, label: str) -> None:
+        if self.guard is not None:
+            self.guard.step(label)
+
+    def prewarm(self, warm_batches: Iterable[Tuple[Dict, Optional[str]]]
+                ) -> None:
+        """Compile the prefill program family up front: one all-pad batch
+        per decode bucket geometry (the compile keys), tagged with the
+        geometry's guard label. The step/insert programs take their single
+        warmup compile at their natural first dispatch."""
+        for host, tag in warm_batches:
+            wire = {k: v for k, v in host.items() if not k.startswith("_")}
+            chunk = self._prefill(self.params, wire)
+            self._guard_step(program_label(PREFILL_KIND, tag))
+            self._ensure_state(chunk)
+
+    def run(self, feed, *, refill_order: str = "fifo"
+            ) -> Iterator[EngineItem]:
+        """Drive the engine over ``feed`` — an iterable of
+        data.feeder.FedBatch items carrying the SAME packed batches the
+        batched-beam path decodes (item.device is the prefill input;
+        item.host keeps the text-cooking fields and the packer's
+        ``_positions``/``_tag`` metadata).
+
+        ``refill_order``: which freed slot a waiting request lands in —
+        "fifo" (queue) or "lifo" (stack). Output is identical either way
+        (results are keyed by split position and samples are slot-
+        independent); the knob exists so the determinism tests can pin
+        exactly that.
+
+        Yields one :class:`EngineItem` per real sample as it settles.
+        """
+        if refill_order not in ("fifo", "lifo"):
+            raise ValueError(f"refill_order {refill_order!r} not in "
+                             f"{{'fifo', 'lifo'}}")
+        cfg = self.cfg
+        S = self.slots
+        depth = max(1, int(cfg.engine_prefill_depth))
+        cadence = max(1, int(cfg.engine_harvest_every))
+        stats = self.stats
+        feed_iter = iter(feed)
+        staged: "collections.deque[_Staged]" = collections.deque()
+        staged_rows = 0
+        free: List[int] = list(range(S))
+        busy: Dict[int, Tuple[int, Dict, int]] = {}
+        exhausted = False
+
+        while True:
+            # prefill ahead: keep `depth` chunks staged, and at least
+            # enough rows to refill every currently free slot
+            while not exhausted and (len(staged) < depth
+                                     or staged_rows < len(free)):
+                try:
+                    item = next(feed_iter)
+                except StopIteration:
+                    exhausted = True
+                    break
+                chunk = self._prefill(self.params, item.device)
+                self._guard_step(program_label(PREFILL_KIND,
+                                               item.host.get("_tag")))
+                self._ensure_state(chunk)
+                stats.prefills += 1
+                positions = item.host.get("_positions")  # bucketed stream only
+                valid = item.host["valid"]
+                rows: "collections.deque[Tuple[int, int]]" = collections.deque()
+                C = valid.shape[0]
+                for r in range(C):
+                    if not valid[r]:
+                        continue
+                    pos_id = (int(positions[r]) if positions is not None  # firacheck: allow[HOST-SYNC] _positions is a host-only numpy field (feeder strips it from the wire); no device value exists here
+                              else item.index * C + r)
+                    rows.append((r, pos_id))
+                if rows:
+                    staged.append(_Staged(chunk=chunk, host=item.host,
+                                          rows=rows))
+                    staged_rows += len(rows)
+
+            # refill every free slot from the staged queue
+            while free and staged:
+                entry = staged[0]
+                C = entry.host["valid"].shape[0]
+                slot_ids = np.full((C,), S, dtype=np.int32)  # S = drop
+                n_ins = 0
+                while free and entry.rows:
+                    r, pos_id = entry.rows.popleft()
+                    slot = (free.pop(0) if refill_order == "fifo"
+                            else free.pop())
+                    slot_ids[r] = slot
+                    busy[slot] = (pos_id, entry.host, r)
+                    n_ins += 1
+                self._state = self._insert(self._state, entry.chunk, slot_ids)
+                self._guard_step(INSERT_LABEL)
+                stats.refills += 1
+                stats.slots_refilled += n_ins
+                staged_rows -= n_ins
+                if not entry.rows:
+                    staged.popleft()
+
+            if not busy:
+                if exhausted:
+                    break
+                continue  # nothing in flight yet: pull more input
+
+            self._state, occ = self._step(self.params, self._state)
+            self._guard_step(STEP_LABEL)
+            stats.step_dispatches += 1
+            stats.steps += cadence
+            # COPIES, not views: the next dispatch DONATES these buffers,
+            # and on the CPU backend a zero-copy device_get view into a
+            # donated buffer dangles
+            stats.occupied_slot_steps += int(np.array(jax.device_get(occ)))  # firacheck: allow[HOST-SYNC] per-dispatch harvest is the engine's designated sync boundary: the refill decision is host-side by construction
+            done = np.array(jax.device_get(self._state["done"]))  # firacheck: allow[HOST-SYNC] same harvest boundary as the line above
+            newly = [s for s in busy if done[s]]
+            if newly:
+                toks = np.array(jax.device_get(self._state["tokens"]))  # firacheck: allow[HOST-SYNC] same harvest boundary: settled beams must reach the host to be cooked into text
+                probs = np.array(jax.device_get(self._state["probs"]))  # firacheck: allow[HOST-SYNC] same harvest boundary as the line above
+                for s in newly:
+                    pos_id, host, r = busy.pop(s)
+                    free.append(s)
+                    stats.commits += 1
+                    yield EngineItem(position=pos_id, host=host, row=r,
+                                     tokens=toks[s], probs=probs[s])

@@ -1,8 +1,23 @@
 """Test-split decoding driver (the reference's `test()`,
-/root/reference/run_model.py:187-380): beam-search every batch, pick the
+/root/reference/run_model.py:187-380): decode every sample, pick the
 argmax-probability beam, cook text, score in-loop sentence BLEU, and write
 one prediction per line to OUTPUT/output_fira (ablations write their own
 suffixed files, matching OUTPUT/output_fira_{no_edit,no_subtoken,nothing}).
+
+Two decode paths, selected by ``cfg.decode_engine`` (CLI ``--engine``;
+bit-exact per sample — docs/DECODE_ENGINE.md):
+
+- **batched beam** (default): one beam program dispatch per packed batch;
+  with ``beam_early_exit`` the dispatch still runs until the batch's
+  LONGEST message settles.
+- **slot-refill engine** (decode/engine.py): S static slots advanced one
+  token per step, settled slots harvested and refilled mid-flight from
+  the same packer stream — wall clock scales with total tokens emitted.
+
+Both paths stream through the ordered writer (decode/stream.py): the
+contiguous split-order prefix is on disk the moment it completes, a crash
+leaves a parseable prefix, and completion atomically renames
+``.partial`` to the final file.
 """
 
 from __future__ import annotations
@@ -19,7 +34,9 @@ from fira_tpu.data import buckets as buckets_lib
 from fira_tpu.data.batching import epoch_index_chunks
 from fira_tpu.data.dataset import FiraDataset
 from fira_tpu.data.feeder import Feeder, assembly_tasks
+from fira_tpu.decode import engine as engine_lib
 from fira_tpu.decode.beam import make_beam_search
+from fira_tpu.decode.stream import OrderedStreamWriter
 from fira_tpu.decode.text import cook_prediction, deanonymize, reference_words
 from fira_tpu.eval.dev_bleu import nltk_sentence_bleu
 from fira_tpu.model.model import FiraModel
@@ -32,122 +49,147 @@ def output_name(ablation: Optional[str]) -> str:
     return f"output_fira_{ablation}"
 
 
+def _decode_tasks(data, cfg: FiraConfig):
+    """The packed decode stream: (tasks, decode bucket table or None).
+    Shared by both decode paths — the engine prefills EXACTLY the batches
+    the batched beam would dispatch."""
+    if cfg.buckets:
+        table = buckets_lib.decode_table(cfg)
+        plan = buckets_lib.packed_plan(data, cfg,
+                                       batch_size=cfg.test_batch_size,
+                                       table=table, use_msg=False)
+        tasks = buckets_lib.bucketed_assembly_tasks(
+            data, plan, cfg, batch_size=cfg.test_batch_size)
+        return tasks, table
+    chunks = epoch_index_chunks(len(data), cfg,
+                                batch_size=cfg.test_batch_size)
+    return assembly_tasks(data, chunks, cfg,
+                          batch_size=cfg.test_batch_size), None
+
+
 def run_test(model: FiraModel, params, dataset: FiraDataset,
              cfg: Optional[FiraConfig] = None, *,
              out_dir: str = "OUTPUT",
              ablation: Optional[str] = None,
              var_maps: Optional[List[Dict[str, str]]] = None,
              split: str = "test",
-             guard=None) -> Dict[str, float]:
-    """``guard``: an armed analysis.sanitizer.CompileGuard — the beam
+             guard=None,
+             engine_slots: Optional[int] = None,
+             refill_order: str = "fifo") -> Dict[str, float]:
+    """``guard``: an armed analysis.sanitizer.CompileGuard — each decode
     program must compile exactly once (warmup), then never again. The CLI
     arms it via ``--sanitize``; library callers use the
-    sanitizer.sanitize() context manager so global config is restored."""
+    sanitizer.sanitize() context manager so global config is restored.
+    ``engine_slots``/``refill_order`` apply to the engine path only (the
+    latter exists so the determinism tests can pin refill-order
+    independence)."""
     cfg = cfg or dataset.cfg
     data = dataset.splits[split]
     vocab = dataset.word_vocab
     indices = dataset.split_indices[split]
-    beam = make_beam_search(model, cfg)
-
-    # Bucketed decode (data/buckets.py): sort-by-length packing over the
-    # (ast nodes, edges) axes — tar_len stays FULL on every decode bucket,
-    # the model decides the output length and it must not be clipped. Each
-    # bucket's beam program is pre-warmed here with an all-pad batch, then
-    # the guard learns the closed family. The packer reorders the sample
-    # stream, so output lines buffer and write in split order at the end
-    # (the buckets-off path keeps its crash-resilient streaming writes).
-    table = None
-    if cfg.buckets:
-        table = buckets_lib.decode_table(cfg)
-        if guard is not None:
-            guard.declare(program_label("beam_search",
-                                        buckets_lib.geom_tag(g))
-                          for g in table)
-        for g in table:
-            beam(params, buckets_lib.warmup_batch(data, cfg, g,
-                                                  cfg.test_batch_size))
-            if guard is not None:
-                guard.step(program_label("beam_search",
-                                         buckets_lib.geom_tag(g)))
-        plan = buckets_lib.packed_plan(data, cfg,
-                                       batch_size=cfg.test_batch_size,
-                                       table=table, use_msg=False)
-        tasks = buckets_lib.bucketed_assembly_tasks(
-            data, plan, cfg, batch_size=cfg.test_batch_size)
-        print(f"decode buckets: {len(table)} beam programs pre-warmed "
-              f"({', '.join(buckets_lib.geom_tag(g) for g in table)})",
-              flush=True)
-    else:
-        chunks = epoch_index_chunks(len(data), cfg,
-                                    batch_size=cfg.test_batch_size)
-        tasks = assembly_tasks(data, chunks, cfg,
-                               batch_size=cfg.test_batch_size)
+    tasks, table = _decode_tasks(data, cfg)
 
     os.makedirs(out_dir, exist_ok=True)
     out_path = os.path.join(out_dir, output_name(ablation))
-    # stream to a .partial file, atomically renamed on completion: full-size
-    # decodes run for tens of minutes and a crash must not cost every line.
-    # Bucketed packing emits samples out of split order, so its .partial
-    # lines stream POSITION-TAGGED ("pos\tline" — still crash-recoverable,
-    # every decoded line is on disk the moment its batch lands) and the
-    # plain split-ordered final file is written from the sorted buffer at
-    # completion; the buckets-off path keeps the historical plain stream.
-    partial_path = out_path + ".partial"
     total_bleu, n = 0.0, 0
-    cursor = 0
     n_total = len(data)
-    buffered: List[tuple] = []  # bucketed mode: (split position, line)
-    # the Feeder is constructed INSIDE the with (after open succeeds): a
-    # failing open must not leak already-started worker threads
-    with open(partial_path, "w") as out_f, \
-            Feeder(tasks, num_workers=cfg.feeder_workers,
-                   depth=cfg.feeder_depth) as feed:
-        for item in feed:
-            batch = item.host  # numpy fields for host-side text cooking
-            tokens, probs = beam(params, item.device)
-            # firacheck: allow[HOST-SYNC] per-batch output collection IS the decode boundary: beams must reach the host to be cooked into text
-            tokens = np.asarray(jax.device_get(tokens))
-            probs = np.asarray(jax.device_get(probs))  # firacheck: allow[HOST-SYNC] same decode output boundary as the line above
-            positions = batch.get("_positions")  # bucketed stream only
-            if guard is not None:
-                guard.step(program_label("beam_search", batch.get("_tag")))
-            valid = batch["valid"]  # host-side numpy batch field, no sync
-            for i in range(tokens.shape[0]):
-                if not valid[i]:
-                    continue
-                best = int(np.argmax(probs[i]))      # run_model.py:351
-                ids = tokens[i, best].tolist()
-                # beam output ids are already copy-resolved at extension time
-                hyp = cook_prediction(ids[1:], batch["diff"][i],
-                                      batch["sub_token"][i], vocab, cfg,
-                                      resolve=False)
-                ref = reference_words(batch["msg"][i], vocab)
-                total_bleu += nltk_sentence_bleu([ref], hyp)
-                n += 1
-                pos = cursor if positions is None else int(positions[i])  # firacheck: allow[HOST-SYNC] _positions is a host-only numpy field (feeder strips it from the wire); no device value exists here
-                var_map = (var_maps[indices[pos]]
-                           if var_maps is not None else None)
-                line = " ".join(deanonymize(hyp, var_map)) + "\n"
-                if positions is None:
-                    out_f.write(line)
-                else:
-                    out_f.write(f"{pos}\t{line}")  # tagged, crash-recoverable
-                    buffered.append((pos, line))
-                cursor += 1
-            if n and n % 1000 < cfg.test_batch_size:
-                out_f.flush()
+    engine_stats = None
+
+    def make_emit(writer):
+        """The per-sample tail both decode paths share: pick the argmax
+        beam, cook text, score BLEU, de-anonymize, write at the sample's
+        split position."""
+
+        def emit(pos, host, row, tokens, probs):
+            nonlocal total_bleu, n
+            best = int(np.argmax(probs))             # run_model.py:351
+            ids = tokens[best].tolist()
+            # beam output ids are already copy-resolved at extension time
+            hyp = cook_prediction(ids[1:], host["diff"][row],
+                                  host["sub_token"][row], vocab, cfg,
+                                  resolve=False)
+            ref = reference_words(host["msg"][row], vocab)
+            total_bleu += nltk_sentence_bleu([ref], hyp)
+            n += 1
+            var_map = (var_maps[indices[pos]]
+                       if var_maps is not None else None)
+            writer.add(pos, " ".join(deanonymize(hyp, var_map)) + "\n")
+            if n % 1000 == 0:
+                writer.flush()
                 print(f"decode: {n}/{n_total}", flush=True)
-    if buffered:
-        # completion: the split-ordered plain file replaces the tagged
-        # stream atomically (write-then-rename, like the plain path)
-        buffered.sort(key=lambda r: r[0])
-        ordered_path = out_path + ".ordered"
-        with open(ordered_path, "w") as f:
-            for _, line in buffered:
-                f.write(line)
-        os.replace(ordered_path, out_path)
-        os.remove(partial_path)
+
+        return emit
+
+    if cfg.decode_engine:
+        eng = engine_lib.SlotEngine(model, params, cfg, slots=engine_slots,
+                                    guard=guard)
+        if table is not None:
+            if guard is not None:
+                guard.declare(
+                    [program_label(engine_lib.PREFILL_KIND,
+                                   buckets_lib.geom_tag(g)) for g in table]
+                    + [engine_lib.STEP_LABEL, engine_lib.INSERT_LABEL])
+            eng.prewarm(
+                (buckets_lib.warmup_batch(data, cfg, g, cfg.test_batch_size),
+                 buckets_lib.geom_tag(g)) for g in table)
+            print(f"decode buckets: {len(table)} engine prefill programs "
+                  f"pre-warmed "
+                  f"({', '.join(buckets_lib.geom_tag(g) for g in table)})",
+                  flush=True)
+        # the Feeder is constructed INSIDE the with (after the writer's
+        # open succeeds): a failing open must not leak worker threads
+        with OrderedStreamWriter(out_path, expected=n_total) as writer, \
+                Feeder(tasks, num_workers=cfg.feeder_workers,
+                       depth=cfg.feeder_depth) as feed:
+            emit = make_emit(writer)
+            for item in eng.run(feed, refill_order=refill_order):
+                emit(item.position, item.host, item.row, item.tokens,
+                     item.probs)
+        engine_stats = eng.stats.summary()
     else:
-        os.replace(partial_path, out_path)
-    return {"sentence_bleu": total_bleu / max(n, 1), "n": float(n),
-            "output_path": out_path}  # type: ignore[return-value]
+        beam = make_beam_search(model, cfg)
+        # Bucketed decode (data/buckets.py): each bucket's beam program is
+        # pre-warmed with an all-pad batch, then the guard learns the
+        # closed family.
+        if table is not None:
+            if guard is not None:
+                guard.declare(program_label("beam_search",
+                                            buckets_lib.geom_tag(g))
+                              for g in table)
+            for g in table:
+                beam(params, buckets_lib.warmup_batch(data, cfg, g,
+                                                      cfg.test_batch_size))
+                if guard is not None:
+                    guard.step(program_label("beam_search",
+                                             buckets_lib.geom_tag(g)))
+            print(f"decode buckets: {len(table)} beam programs pre-warmed "
+                  f"({', '.join(buckets_lib.geom_tag(g) for g in table)})",
+                  flush=True)
+        cursor = 0
+        with OrderedStreamWriter(out_path, expected=n_total) as writer, \
+                Feeder(tasks, num_workers=cfg.feeder_workers,
+                       depth=cfg.feeder_depth) as feed:
+            emit = make_emit(writer)
+            for item in feed:
+                batch = item.host  # numpy fields for host-side text cooking
+                tokens, probs = beam(params, item.device)
+                # firacheck: allow[HOST-SYNC] per-batch output collection IS the decode boundary: beams must reach the host to be cooked into text
+                tokens = np.asarray(jax.device_get(tokens))
+                probs = np.asarray(jax.device_get(probs))  # firacheck: allow[HOST-SYNC] same decode output boundary as the line above
+                positions = batch.get("_positions")  # bucketed stream only
+                if guard is not None:
+                    guard.step(program_label("beam_search",
+                                             batch.get("_tag")))
+                valid = batch["valid"]  # host-side numpy field, no sync
+                for i in range(tokens.shape[0]):
+                    if not valid[i]:
+                        continue
+                    pos = cursor if positions is None else int(positions[i])  # firacheck: allow[HOST-SYNC] _positions is a host-only numpy field (feeder strips it from the wire); no device value exists here
+                    emit(pos, batch, i, tokens[i], probs[i])
+                    cursor += 1
+    out: Dict[str, float] = {
+        "sentence_bleu": total_bleu / max(n, 1), "n": float(n),
+        "output_path": out_path}  # type: ignore[assignment]
+    if engine_stats is not None:
+        out["engine"] = engine_stats  # type: ignore[assignment]
+    return out  # type: ignore[return-value]

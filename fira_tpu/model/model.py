@@ -312,6 +312,32 @@ class Decoder(nn.Module):
             x = getattr(self, f"ffn_{i}")(x, deterministic=True)
         return x, k_cache, v_cache
 
+    def decode_step_multi(self, tok, pos_idx, k_cache, v_cache, cross_k,
+                          cross_v, sou_mask, self_mask):
+        """One cached decode position PER ROW: like :meth:`decode_step` but
+        ``pos_idx`` is a (B,) vector — row b advances its own position
+        ``pos_idx[b]``. The slot-refill engine (decode/engine.py) holds
+        samples at mixed decode depths in one fixed-shape program, so the
+        shared-scalar position of the batch beam does not apply. Per row
+        the math is identical to :meth:`decode_step` at that row's scalar
+        position: the position-table row is gathered per row instead of
+        sliced once, and the cache write scatters per-row columns."""
+        B = tok.shape[0]
+        pos = pos_idx.astype(jnp.int32)
+        b_idx = jnp.arange(B)
+        x = self.embed(tok) + self._pos_table()[pos][:, None, :]
+        for i in range(self.cfg.num_layers):
+            sa = getattr(self, f"self_attn_{i}")
+            k_new, v_new = sa.project_kv(x, x)       # (B, H, 1, d_head)
+            k_cache = k_cache.at[i, b_idx, :, pos, :].set(k_new[:, :, 0, :])
+            v_cache = v_cache.at[i, b_idx, :, pos, :].set(v_new[:, :, 0, :])
+            x = sa.attend(x, k_cache[i], v_cache[i], self_mask,
+                          deterministic=True)
+            x = getattr(self, f"cross_attn_{i}").attend(
+                x, cross_k[i], cross_v[i], sou_mask, deterministic=True)
+            x = getattr(self, f"ffn_{i}")(x, deterministic=True)
+        return x, k_cache, v_cache
+
 
 class _ScoreHead(nn.Module):
     """Parameter container matching TorchDense(1, name="score") exactly
@@ -518,6 +544,19 @@ class FiraModel(nn.Module):
         return self._dist_parts(states, mask, tar, tar_mask_pad,
                                 deterministic=deterministic)
 
+    def _step_heads(self, mask, src_proj, tar_emb):
+        """Shared generation/copy/gate head of the cached one-position
+        decode paths (scalar-position :meth:`dist_parts_step` and the
+        engine's per-row :meth:`dist_parts_step_multi`)."""
+        gen = jax.nn.softmax(
+            self.out_fc(tar_emb).astype(stable_dtype(self.dtype)), axis=-1
+        )
+        scores, gate = self.copy_net.score_gate(src_proj, tar_emb)
+        scores = jnp.where(mask[:, None, :], scores,
+                           jnp.asarray(-1e9, scores.dtype))
+        copy = jax.nn.softmax(scores.astype(stable_dtype(self.dtype)), axis=-1)
+        return gen, copy, gate
+
     def dist_parts_step(self, mask, tok, pos_idx, k_cache, v_cache,
                         cross_k, cross_v, src_proj, self_mask):
         """One-position distribution FACTORS with KV caching: the
@@ -529,14 +568,32 @@ class FiraModel(nn.Module):
         tar_emb, k_cache, v_cache = self.decoder.decode_step(
             tok, pos_idx, k_cache, v_cache, cross_k, cross_v, mask, self_mask,
         )
-        gen = jax.nn.softmax(
-            self.out_fc(tar_emb).astype(stable_dtype(self.dtype)), axis=-1
-        )
-        scores, gate = self.copy_net.score_gate(src_proj, tar_emb)
-        scores = jnp.where(mask[:, None, :], scores,
-                           jnp.asarray(-1e9, scores.dtype))
-        copy = jax.nn.softmax(scores.astype(stable_dtype(self.dtype)), axis=-1)
+        gen, copy, gate = self._step_heads(mask, src_proj, tar_emb)
         return gen, copy, gate, k_cache, v_cache
+
+    def dist_parts_step_multi(self, mask, tok, pos_idx, k_cache, v_cache,
+                              cross_k, cross_v, src_proj, self_mask):
+        """Per-ROW-position twin of :meth:`dist_parts_step` (``pos_idx`` is
+        a (B,) vector): the slot-refill engine's step program advances every
+        slot at its own depth in one dispatch (decode/engine.py). Row-wise
+        identical math — Decoder.decode_step_multi plus the same heads."""
+        tar_emb, k_cache, v_cache = self.decoder.decode_step_multi(
+            tok, pos_idx, k_cache, v_cache, cross_k, cross_v, mask, self_mask,
+        )
+        gen, copy, gate = self._step_heads(mask, src_proj, tar_emb)
+        return gen, copy, gate, k_cache, v_cache
+
+    def fused_probs_step_multi(self, mask, tok, pos_idx, k_cache, v_cache,
+                               cross_k, cross_v, src_proj, self_mask):
+        """Per-ROW-position twin of :meth:`fused_probs_step` — the engine's
+        non-factored step head. Returns (fused (B, 1, V_out), caches)."""
+        gen, copy, gate, k_cache, v_cache = self.dist_parts_step_multi(
+            mask, tok, pos_idx, k_cache, v_cache, cross_k, cross_v,
+            src_proj, self_mask)
+        fused = jnp.concatenate(
+            [gate[:, :, 0:1] * gen, gate[:, :, 1:2] * copy], axis=-1
+        )
+        return fused, k_cache, v_cache
 
     def fused_probs_step(self, mask, tok, pos_idx, k_cache, v_cache,
                          cross_k, cross_v, src_proj, self_mask):

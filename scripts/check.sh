@@ -8,15 +8,17 @@ set -u
 cd "$(dirname "$0")/.."
 
 echo "== firacheck: static JAX-hazard scan =="
-# fira_tpu/data/feeder.py, fira_tpu/data/buckets.py and
-# fira_tpu/data/grouping.py are named explicitly (as well as being inside
-# the fira_tpu tree, which the CLI dedupes): the async input pipeline, the
-# bucket packer and the grouped dispatch scheduler are designated driver
-# modules (astutil._DRIVER_FILES) whose threaded/packing loops MUST stay
-# in the self-scan even if the directory arguments ever change.
+# fira_tpu/data/feeder.py, fira_tpu/data/buckets.py,
+# fira_tpu/data/grouping.py and fira_tpu/decode/engine.py are named
+# explicitly (as well as being inside the fira_tpu tree, which the CLI
+# dedupes): the async input pipeline, the bucket packer, the grouped
+# dispatch scheduler and the slot-refill decode engine are designated
+# driver modules (astutil._DRIVER_FILES) whose threaded/packing/refill
+# loops MUST stay in the self-scan even if the directory arguments ever
+# change.
 JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu fira_tpu/data/feeder.py fira_tpu/data/buckets.py \
-    fira_tpu/data/grouping.py tests scripts \
+    fira_tpu/data/grouping.py fira_tpu/decode/engine.py tests scripts \
     || exit $?
 
 echo "== tier-1 pytest (ROADMAP.md verify, verbatim) =="

@@ -29,9 +29,15 @@ DTYPE = os.environ.get("DECODE_DTYPE", "bfloat16")
 # flagship beam on CPU takes tens of minutes; the tiny geometry compiles in
 # seconds). The official rows are fira-full.
 CONFIG = os.environ.get("DECODE_CONFIG", "fira-full")
+# DECODE_TAR_LEN: override the message-position budget — the CPU engine
+# smoke runs fira-tiny geometry at the flagship tar 30 so the
+# length-mix rows exercise the real 29-step budget.
+TAR_LEN = os.environ.get("DECODE_TAR_LEN")
 
 cfg0 = get_config(CONFIG).replace(batch_size=BATCH, test_batch_size=BATCH,
-                                  compute_dtype=DTYPE)
+                                  compute_dtype=DTYPE,
+                                  **({"tar_len": int(TAR_LEN)}
+                                     if TAR_LEN else {}))
 pad_v = 24650 if CONFIG == "fira-full" else 0
 cfg0, split, _ = make_memory_split(cfg0, max(256, BATCH), seed=0,
                                    pad_vocab_to=pad_v,
@@ -108,4 +114,128 @@ for tag, over in VARIANTS:
 print(json.dumps({
     "tag": "speedup_kv_over_full",
     "value": round(results["full_redecode"] / results["kv_cached"], 2),
+}), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Slot-refill engine rows (decode/engine.py): continuous batching over a
+# STREAM of batches, vs the batched early-exit path at equal geometry.
+# Three paramset brackets:
+#   engine            random params — no beam ever emits EOS, every slot
+#                     runs the full budget: the engine's own worst case
+#                     (pure per-step dispatch overhead vs the fused scan);
+#   engine_saturated  hard EOS bias — slots settle almost immediately:
+#                     refill-bound best case;
+#   engine_mixed      moderate EOS bias (DECODE_ENGINE_EOS_DELTA) — per-
+#                     sample settle depths SPREAD like a real corpus
+#                     (mean ~8-10 tokens against the tar-1 budget at the
+#                     default delta), the regime where the batch path pays
+#                     the per-batch max and the engine pays the mean. Its
+#                     twin row kv_early_exit_mixed runs the batched
+#                     early-exit beam on the SAME stream and paramset;
+#                     speedup_engine_over_early_exit_mixed is the ratio.
+# Measurement protocol is mirrored by bench.py's FIRA_BENCH_DECODE_ENGINE
+# leg — change the warm/reset/sync boundaries in BOTH places or the two
+# reported speedups silently diverge.
+# --------------------------------------------------------------------------
+from fira_tpu.data.feeder import Feeder
+from fira_tpu.decode import engine as engine_lib
+
+# 6 batches (192 commits at batch 32) is the shortest stream where the
+# end-of-stream drain (no refills left for the last slots standing) stops
+# dominating engine slot_occupancy; a 4-batch stream understates the
+# steady-state engine by ~15% on CPU.
+ENGINE_BATCHES = int(os.environ.get("DECODE_ENGINE_BATCHES", "6"))
+ENGINE_MIX_DELTA = float(os.environ.get("DECODE_ENGINE_EOS_DELTA", "4.75"))
+
+cfg_eng = cfg0.replace(beam_kv_cache=True, beam_factored_topk=False)
+model_eng = FiraModel(cfg_eng, dtype=jnp.dtype(DTYPE))
+params_mixed = eos_biased_params(params, delta=ENGINE_MIX_DELTA)
+
+rng_eng = np.random.RandomState(1)
+stream_chunks = [rng_eng.choice(len(split), BATCH, replace=True)
+                 for _ in range(ENGINE_BATCHES)]
+
+
+def stream_tasks():
+    for ix in stream_chunks:
+        yield (lambda ix=ix: make_batch(split, ix, cfg_eng))
+
+
+def drive_engine(eng):
+    with Feeder(stream_tasks(), num_workers=2, depth=2) as feed:
+        for _ in eng.run(feed):
+            pass
+
+
+def engine_row(tag, ps):
+    eng = engine_lib.SlotEngine(model_eng, ps, cfg_eng)
+    t0 = time.perf_counter()
+    drive_engine(eng)                      # compiles prefill/step/insert
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(2):
+        eng.stats = engine_lib.EngineStats(slots=eng.slots)
+        t0 = time.perf_counter()
+        drive_engine(eng)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    st = eng.stats.summary()
+    cps = st["commits"] / dt
+    print(json.dumps({
+        "tag": tag, "commits_per_sec": round(cps, 1),
+        "batch": BATCH, "slots": st["slots"], "beam": cfg_eng.beam_size,
+        "tar_len": cfg_eng.tar_len, "n_commits": st["commits"],
+        "slot_occupancy": st["slot_occupancy"],
+        "steps_run": st["steps_run"], "refills": st["refills"],
+        "steps_per_commit": st["steps_per_commit"],
+        "dispatches": st["dispatches"],
+        "compile_s": round(compile_s, 1),
+    }), flush=True)
+    return cps
+
+
+def batch_early_exit_row(tag, ps):
+    # SAME input pipeline as the engine row (assembly + H2D through the
+    # async Feeder, inside the timed window) — the speedup ratio must
+    # compare decode strategies, not who pre-staged their batches
+    cfgb = cfg_eng.replace(beam_early_exit=True)
+    model_b = FiraModel(cfgb, dtype=jnp.dtype(DTYPE))
+    beam_b = make_beam_search(model_b, cfgb, with_steps=True)
+    warm = jax.device_put(make_batch(split, stream_chunks[0], cfgb))
+    jax.block_until_ready(warm)
+    out = beam_b(ps, warm)
+    _ = np.asarray(out[0])                 # compile + honest sync
+    times = []
+    steps_total = 0
+    for _w in range(2):
+        steps_total = 0
+        t0 = time.perf_counter()
+        with Feeder(stream_tasks(), num_workers=2, depth=2) as feed:
+            for item in feed:
+                out = beam_b(ps, item.device)
+                # per-batch D2H, the same harvest boundary run_test pays
+                steps_total += int(out[2])
+                _ = np.asarray(out[0])
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    n_commits = BATCH * len(stream_chunks)
+    cps = n_commits / dt
+    print(json.dumps({
+        "tag": tag, "commits_per_sec": round(cps, 1),
+        "batch": BATCH, "beam": cfgb.beam_size, "tar_len": cfgb.tar_len,
+        "n_commits": n_commits, "steps_run": steps_total,
+        "steps_per_commit": round(steps_total / n_commits, 3),
+        "dispatches": len(stream_chunks),
+    }), flush=True)
+    return cps
+
+
+v_batch_mixed = batch_early_exit_row("kv_early_exit_mixed", params_mixed)
+v_engine_mixed = engine_row("engine_mixed", params_mixed)
+engine_row("engine", params)
+engine_row("engine_saturated", params_eos)
+print(json.dumps({
+    "tag": "speedup_engine_over_early_exit_mixed",
+    "value": round(v_engine_mixed / v_batch_mixed, 2),
 }), flush=True)

@@ -1,0 +1,318 @@
+"""Slot-refill continuous-batching decode engine (decode/engine.py).
+
+Pins the engine's whole contract:
+
+- per-sample BIT-EXACTNESS (tokens AND probs) vs the batched beam in all
+  four kv-cache x factored-topk modes — the equivalence the production
+  DECODE_PERF_KNOBS preset rides on;
+- scheduler determinism: identical output file bytes for any prefill-queue
+  depth, feeder worker count, and refill order;
+- the ordered streaming writer (decode/stream.py): contiguous-prefix
+  flushing, atomic completion, and the crash contract (a kill mid-run
+  leaves a parseable plain prefix of the final file);
+- the compile-guard story: the (geometry x {prefill, step, insert})
+  program family warms once, then zero post-warmup compiles.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from fira_tpu.analysis import sanitizer
+from fira_tpu.config import fira_tiny
+from fira_tpu.data.dataset import FiraDataset
+from fira_tpu.data.feeder import Feeder
+from fira_tpu.data.synthetic import write_corpus_dir
+from fira_tpu.decode import engine as engine_lib
+from fira_tpu.decode.beam import eos_biased_params, make_beam_search
+from fira_tpu.decode.runner import _decode_tasks, run_test
+from fira_tpu.decode.stream import OrderedStreamWriter
+from fira_tpu.model.model import FiraModel
+from fira_tpu.train.state import init_state
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    data_dir = str(tmp_path_factory.mktemp("corpus"))
+    write_corpus_dir(data_dir, n_commits=40, seed=13)
+    cfg = fira_tiny(batch_size=8, test_batch_size=6)
+    dataset = FiraDataset(data_dir, cfg)
+    cfg = dataset.cfg
+    from fira_tpu.data.batching import make_batch
+
+    batch = make_batch(dataset.splits["train"], np.arange(6), cfg)
+    params = init_state(FiraModel(cfg), cfg, batch).params
+    # moderate EOS bias: beams settle at MIXED depths across samples (the
+    # schedule the refill loop exists for), yet well before tar_len-1 —
+    # the engine path is exercised non-vacuously in a few steps/slot
+    return cfg, dataset, params, eos_biased_params(params, delta=4.0)
+
+
+MODES = [
+    # (kv_cache, factored_topk)
+    (True, False),
+    (True, True),
+    (False, False),
+    (False, True),
+]
+
+
+@pytest.mark.parametrize("kv,fac", MODES)
+def test_engine_bit_exact_per_sample(setup, kv, fac):
+    """Engine (tokens, probs) == batched beam (tokens, probs), per sample,
+    bitwise — in every kv-cache x factored-topk mode."""
+    cfg0, dataset, _params, eos_params = setup
+    cfg = dataclasses.replace(cfg0, beam_kv_cache=kv, beam_factored_topk=fac)
+    model = FiraModel(cfg)
+    data = dataset.splits["train"]  # the big split: several batches, real refill pressure
+
+    # batched-beam reference, keyed by split position
+    beam = make_beam_search(model, cfg)
+    expected = {}
+    tasks, _ = _decode_tasks(data, cfg)
+    with Feeder(tasks, num_workers=0, depth=1) as feed:
+        for item in feed:
+            toks, probs = beam(eos_params, item.device)
+            toks, probs = np.asarray(toks), np.asarray(probs)
+            C = item.host["valid"].shape[0]
+            for i in range(C):
+                if item.host["valid"][i]:
+                    expected[item.index * C + i] = (toks[i], probs[i])
+
+    eng = engine_lib.SlotEngine(model, eos_params, cfg)
+    tasks2, _ = _decode_tasks(data, cfg)
+    seen = set()
+    with Feeder(tasks2, num_workers=0, depth=1) as feed:
+        for it in eng.run(feed):
+            assert it.position not in seen
+            seen.add(it.position)
+            ref_toks, ref_probs = expected[it.position]
+            np.testing.assert_array_equal(it.tokens, ref_toks)
+            np.testing.assert_array_equal(it.probs, ref_probs)
+    assert seen == set(expected)
+    assert eng.stats.commits == len(data)
+    # the engine must actually retire+refill mid-flight, not run one
+    # monolithic pass: with mixed settle depths there are more refill
+    # dispatches than the initial fill alone
+    assert eng.stats.slots_refilled == len(data)
+    assert 0.0 < eng.stats.slot_occupancy <= 1.0
+
+
+def test_engine_run_test_file_identical_and_zero_retraces(setup, tmp_path):
+    """run_test --engine writes the byte-identical output file (and BLEU)
+    of the batched-beam path on a BUCKETED stream, under the armed
+    sanitizer: the declared (geometry x {prefill, step, insert}) family
+    warms once, then zero post-warmup compiles."""
+    cfg0, dataset, _params, eos_params = setup
+    # a two-entry decode bucket family for the tiny geometry (+ implicit
+    # full fallback); tar is pinned full by decode_table regardless
+    cfg = dataclasses.replace(cfg0, buckets=((16, 400, 12),))
+    model = FiraModel(cfg)
+
+    off = run_test(model, eos_params, dataset,
+                   dataclasses.replace(cfg, decode_engine=False),
+                   out_dir=str(tmp_path / "off"), split="train")
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        on = run_test(model, eos_params, dataset,
+                      dataclasses.replace(cfg, decode_engine=True),
+                      out_dir=str(tmp_path / "on"), guard=guard,
+                      split="train")
+        assert guard.compiles_after_warmup() == 0
+    assert open(off["output_path"]).read() == open(on["output_path"]).read()
+    assert off["sentence_bleu"] == on["sentence_bleu"]
+    assert on["engine"]["commits"] == len(dataset.splits["train"])
+    # no stray .partial / tagged tail left behind on a clean completion
+    assert not os.path.exists(on["output_path"] + ".partial")
+    assert not os.path.exists(on["output_path"] + ".partial.tail")
+
+
+def test_engine_determinism_any_queue_depth_and_refill_order(setup, tmp_path):
+    """Same (seed, corpus, slot count) => identical output file bytes for
+    any prefill-queue depth, any feeder worker count, either refill
+    order, and any harvest cadence."""
+    cfg0, dataset, _params, eos_params = setup
+    cfg = dataclasses.replace(cfg0, decode_engine=True)
+    model = FiraModel(cfg)
+
+    variants = [
+        dict(prefill_depth=1, workers=0, refill_order="fifo", cadence=1),
+        dict(prefill_depth=3, workers=2, refill_order="fifo", cadence=4),
+        dict(prefill_depth=2, workers=1, refill_order="lifo", cadence=3),
+    ]
+    outputs = []
+    for i, v in enumerate(variants):
+        c = dataclasses.replace(cfg,
+                                engine_prefill_depth=v["prefill_depth"],
+                                engine_harvest_every=v["cadence"],
+                                feeder_workers=v["workers"])
+        m = run_test(model, eos_params, dataset, c,
+                     out_dir=str(tmp_path / f"v{i}"), split="train",
+                     refill_order=v["refill_order"])
+        outputs.append(open(m["output_path"]).read())
+    assert outputs[0] == outputs[1] == outputs[2]
+    with pytest.raises(ValueError, match="refill_order"):
+        next(iter(engine_lib.SlotEngine(model, eos_params, cfg).run(
+            [], refill_order="random")))
+
+
+def test_engine_slot_count_decoupled_from_batch(setup, tmp_path):
+    """S need not equal the packed batch size: a smaller arena (heavy
+    partial-chunk insert pressure) and a larger one both write the exact
+    batched-path bytes."""
+    cfg0, dataset, _params, eos_params = setup
+    cfg = dataclasses.replace(cfg0, decode_engine=True)
+    model = FiraModel(cfg)
+    ref = run_test(model, eos_params, dataset,
+                   dataclasses.replace(cfg, decode_engine=False),
+                   out_dir=str(tmp_path / "ref"), split="train")
+    ref_text = open(ref["output_path"]).read()
+    for slots in (4, 10):
+        m = run_test(model, eos_params, dataset, cfg,
+                     out_dir=str(tmp_path / f"s{slots}"), split="train",
+                     engine_slots=slots)
+        assert open(m["output_path"]).read() == ref_text, slots
+        assert m["engine"]["slots"] == slots
+
+
+def test_engine_retires_early_on_settled_beams(setup):
+    """With EOS-biased params every slot settles in a few positions: the
+    engine's total step count must come in far below the batched full-scan
+    budget (batches x tar_len-1) — the continuous-batching win exists."""
+    cfg0, dataset, _params, eos_params = setup
+    cfg = dataclasses.replace(cfg0, beam_kv_cache=True)
+    model = FiraModel(cfg)
+    data = dataset.splits["train"]  # the big split: several batches, real refill pressure
+    eng = engine_lib.SlotEngine(model, eos_params, cfg)
+    tasks, _ = _decode_tasks(data, cfg)
+    with Feeder(tasks, num_workers=0, depth=1) as feed:
+        for _ in eng.run(feed):
+            pass
+    n_batches = -(-len(data) // cfg.test_batch_size)
+    full_budget = n_batches * (cfg.tar_len - 1)
+    assert 0 < eng.stats.steps < full_budget, eng.stats.summary()
+    assert eng.stats.prefills == n_batches
+
+
+# --------------------------------------------------------------------------
+# ordered streaming writer
+# --------------------------------------------------------------------------
+
+def test_stream_writer_flushes_contiguous_prefix(tmp_path):
+    path = str(tmp_path / "out")
+    w = OrderedStreamWriter(path)
+    w.add(2, "c\n")
+    w.add(0, "a\n")
+    w.flush()
+    # position 1 missing: only the [0] prefix may be on disk
+    assert open(path + ".partial").read() == "a\n"
+    assert w.written == 1 and w.pending == 1
+    w.add(1, "b\n")
+    w.flush()
+    assert open(path + ".partial").read() == "a\nb\nc\n"
+    assert w.close() == path
+    assert open(path).read() == "a\nb\nc\n"
+    assert not os.path.exists(path + ".partial")
+    assert not os.path.exists(path + ".partial.tail")
+
+
+def test_stream_writer_rejects_duplicates_and_gaps(tmp_path):
+    w = OrderedStreamWriter(str(tmp_path / "out"))
+    w.add(0, "a\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        w.add(0, "again\n")
+    w.add(2, "c\n")
+    with pytest.raises(RuntimeError, match="gap"):
+        w.close()  # position 1 never arrived
+    # the flushed prefix survives the failed close
+    assert open(str(tmp_path / "out") + ".partial").read() == "a\n"
+
+
+def test_stream_writer_detects_suffix_truncation(tmp_path):
+    """Tail-of-split samples that never arrive leave no interior gap; the
+    expected-count check refuses to rename the truncated file."""
+    w = OrderedStreamWriter(str(tmp_path / "out"), expected=3)
+    w.add(0, "a\n")
+    w.add(1, "b\n")
+    with pytest.raises(RuntimeError, match="never decoded"):
+        w.close()
+    assert not os.path.exists(str(tmp_path / "out"))
+    assert open(str(tmp_path / "out") + ".partial").read() == "a\nb\n"
+
+
+def test_stream_writer_close_after_abort_raises(tmp_path):
+    """close() after an abort must not report success: the final file was
+    never produced, only the .partial recovery pair exists."""
+    w = OrderedStreamWriter(str(tmp_path / "out"))
+    w.add(0, "a\n")
+    w.abort()
+    with pytest.raises(RuntimeError, match="aborted"):
+        w.close()
+    # a failed close (gap/truncation) aborts internally — retrying it
+    # must keep raising, never hand back the nonexistent final path
+    w2 = OrderedStreamWriter(str(tmp_path / "out2"), expected=2)
+    w2.add(0, "a\n")
+    with pytest.raises(RuntimeError, match="never decoded"):
+        w2.close()
+    with pytest.raises(RuntimeError, match="aborted"):
+        w2.close()
+
+
+def test_stream_writer_crash_leaves_parseable_prefix(tmp_path):
+    """Context-manager exception path == the kill contract: .partial holds
+    the plain contiguous prefix, no rename."""
+    path = str(tmp_path / "out")
+    with pytest.raises(RuntimeError, match="boom"):
+        with OrderedStreamWriter(path) as w:
+            w.add(0, "a\n")
+            w.add(1, "b\n")
+            w.add(5, "f\n")  # above the gap: must NOT reach disk
+            raise RuntimeError("boom")
+    assert not os.path.exists(path)
+    assert open(path + ".partial").read() == "a\nb\n"
+    # the above-gap line is on disk too, position-tagged: a crash costs
+    # nothing that was decoded
+    assert open(path + ".partial.tail").read() == "5\tf\n"
+
+
+def test_engine_kill_mid_run_leaves_parseable_prefix(setup, tmp_path):
+    """A crash mid-decode (here: the BLEU scorer dying partway) leaves
+    output_fira.partial = a byte-exact prefix of the completed run's
+    file."""
+    import fira_tpu.decode.runner as runner_mod
+
+    cfg0, dataset, _params, eos_params = setup
+    cfg = dataclasses.replace(cfg0, decode_engine=True)
+    model = FiraModel(cfg)
+    full = run_test(model, eos_params, dataset, cfg,
+                    out_dir=str(tmp_path / "full"), split="train")
+    full_lines = open(full["output_path"]).read().splitlines(keepends=True)
+
+    calls = {"n": 0}
+    real = runner_mod.nltk_sentence_bleu
+
+    def dying(*a, **k):
+        calls["n"] += 1
+        if calls["n"] > 9:
+            raise RuntimeError("killed mid-run")
+        return real(*a, **k)
+
+    runner_mod.nltk_sentence_bleu = dying
+    try:
+        with pytest.raises(RuntimeError, match="killed mid-run"):
+            run_test(model, eos_params, dataset, cfg,
+                     out_dir=str(tmp_path / "killed"), split="train")
+    finally:
+        runner_mod.nltk_sentence_bleu = real
+    out_path = os.path.join(str(tmp_path / "killed"), "output_fira")
+    assert not os.path.exists(out_path)  # never renamed
+    partial = open(out_path + ".partial").read().splitlines(keepends=True)
+    assert len(partial) < len(full_lines)
+    assert partial == full_lines[: len(partial)]
+    # every decoded-but-unflushed line survives in the tagged tail, in its
+    # final form
+    if os.path.exists(out_path + ".partial.tail"):
+        for tagged in open(out_path + ".partial.tail"):
+            pos_s, line = tagged.split("\t", 1)
+            assert line == full_lines[int(pos_s)]
