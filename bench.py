@@ -124,7 +124,13 @@ FIRA_BENCH_DECODE_ENGINE=1 (opt-in decode leg: slot-refill continuous-
 batching engine vs the batched early-exit beam on the same 3-batch
 eos-biased stream — decode/engine.py; the watchdog harvest sets it),
 FIRA_BENCH_DECODE_EOS_DELTA (default 4.75 — the mixed-settle EOS bias of
-that leg's paramset).
+that leg's paramset),
+FIRA_BENCH_MULTICHIP=1 (opt-in multi-chip scaling leg: runs
+scripts/multichip_bench.py — grouped sharded train + replicated engine
+fleet at 1/2/4/8 virtual CPU devices, one fresh subprocess per count —
+and folds its per-device-count rows into this record; the full artifact
+lands in MULTICHIP_r06.json. FIRA_BENCH_MULTICHIP_TIMEOUT caps the whole
+sweep, default 1800 s),
 
 Composed leg — the production path going forward (ISSUE 4): the stacked
 knobs AND the auto bucket table together. One shuffled epoch plan of
@@ -728,6 +734,42 @@ def worker() -> None:
             print(f"decode engine leg failed: {e!r}", file=sys.stderr)
             decode_engine = {"error": repr(e)}
 
+    # (f) MULTICHIP leg (opt-in: FIRA_BENCH_MULTICHIP=1): the composed
+    # stack at 1/2/4/8 logical devices — sharded grouped train + the
+    # replicated engine fleet — via scripts/multichip_bench.py (one fresh
+    # subprocess per device count: the virtual device count pins at
+    # backend init). Writes MULTICHIP_r06.json at the repo root and folds
+    # the per-device-count rows into this record; failures degrade to a
+    # structured error field, never sinking the main measurement.
+    multichip = None
+    if os.environ.get("FIRA_BENCH_MULTICHIP", "0") == "1":
+        try:
+            script = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "scripts", "multichip_bench.py")
+            p = subprocess.run(
+                [sys.executable, script], text=True,
+                timeout=float(os.environ.get(
+                    "FIRA_BENCH_MULTICHIP_TIMEOUT", "1800")),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            rec = _last_json_line(p.stdout or "")
+            # Fold rows whenever the orchestrator emitted any: it exits 1
+            # if ANY child device count failed (e.g. the 8-device leg on an
+            # oversubscribed host), but the surviving rows are still the
+            # measurement of record.
+            if rec and rec.get("rows"):
+                multichip = {k: rec[k] for k in
+                             ("rows", "host_cores", "monotonic_train_1_to_4",
+                              "monotonic_fleet_1_to_4", "errors") if k in rec}
+                if p.returncode != 0:
+                    multichip["partial_rc"] = p.returncode
+            else:
+                multichip = {"error": f"rc={p.returncode}",
+                             "tail": (p.stderr or p.stdout or "")[-300:]}
+        except Exception as e:
+            print(f"multichip leg failed: {e!r}", file=sys.stderr)
+            multichip = {"error": repr(e)}
+
     step_time = dt_e2e / steps_per_window
     compute_step_time = dt_compute / steps_per_window
     # metric of record: chip-side throughput (see module docstring "History
@@ -776,6 +818,9 @@ def worker() -> None:
         # slot-refill engine decode vs batched early exit on the same
         # stream (FIRA_BENCH_DECODE_ENGINE=1; decode/engine.py)
         **({"decode_engine": decode_engine} if decode_engine else {}),
+        # multi-chip scaling rows (FIRA_BENCH_MULTICHIP=1; the full
+        # artifact is MULTICHIP_r06.json — scripts/multichip_bench.py)
+        **({"multichip": multichip} if multichip else {}),
         "feed_stall_frac_sync_assembly": sync_info["feed_stall_frac"],
         "value_e2e_sync_assembly": round(
             batch_size / (dt_sync / steps_per_window) / n_chips, 2),

@@ -51,6 +51,7 @@ _DRIVER_FILES = (
     "fira_tpu/decode/engine.py",
     "fira_tpu/data/feeder.py", "fira_tpu/data/buckets.py",
     "fira_tpu/data/grouping.py",
+    "fira_tpu/parallel/fleet.py",
 )
 
 

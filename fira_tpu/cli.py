@@ -101,6 +101,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "advanced per step dispatch before the host "
                         "harvests settled slots (default 4; output-"
                         "identical for any R, pinned by tests)")
+    p.add_argument("--engine-replicas", type=_positive, default=None,
+                   metavar="N",
+                   help="test: replicated slot-engine decode fleet "
+                        "(parallel/fleet.py, docs/MULTICHIP.md): N engine "
+                        "replicas — one per device — pull chunks from one "
+                        "shared admission queue with harvest/refill "
+                        "interleaved across replicas. Output file bytes "
+                        "are invariant to N (pinned by tests). A nonzero "
+                        "--engine-slots is the fleet TOTAL and must divide "
+                        "by N")
     p.add_argument("--beam-log-space", action="store_true",
                    help="log-space beam accumulation instead of the "
                         "reference-compat probability space")
@@ -218,6 +228,8 @@ def _resolve_cfg(args):
         overrides["engine_prefill_depth"] = args.engine_prefill_depth
     if args.engine_harvest_every is not None:
         overrides["engine_harvest_every"] = args.engine_harvest_every
+    if args.engine_replicas is not None:
+        overrides["engine_replicas"] = args.engine_replicas
     if args.adjacency:
         overrides["adjacency_impl"] = args.adjacency
     if args.encoder_buffer:
@@ -325,6 +337,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"buckets: {', '.join(f'{a}:{e}:{t}' for a, e, t in table)} "
               f"(+ full fallback)")
 
+    # Mesh / fleet divisibility validates HERE, at parse time (exit 2,
+    # named-bucket messages) — not as a mid-run XLA reshape error deep in
+    # the first epoch (docs/MULTICHIP.md).
+    from fira_tpu.parallel import mesh as pmesh
+
+    mesh = _make_mesh(args.mesh) if args.command == "train" else None
+    errs = list(pmesh.divisibility_errors(
+        cfg, mesh.shape[pmesh.DATA_AXIS] if mesh is not None else 1))
+    if cfg.decode_engine:
+        from fira_tpu.parallel.fleet import fleet_divisibility_errors
+
+        errs += fleet_divisibility_errors(cfg)
+    if errs:
+        for e in errs:
+            print(f"mesh divisibility: {e}", file=sys.stderr)
+        return 2
+
     var_maps = _load_var_maps(args.data_dir)
     suffix = f"_{args.ablation}" if args.ablation else ""
     ckpt_dir = args.ckpt_dir or os.path.join(args.out_dir, f"ckpt{suffix}")
@@ -339,7 +368,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command == "train":
         from fira_tpu.train.loop import train
 
-        mesh = _make_mesh(args.mesh)
         result = train(
             dataset, cfg, mesh=mesh, out_dir=args.out_dir,
             ckpt_dir=ckpt_dir, epochs=args.epochs, var_maps=var_maps,

@@ -170,6 +170,17 @@ class FiraConfig:
     # CPU length-mix bench (scripts/tpu_decode_bench.py engine_mixed row)
     # and the occupancy loss shows up honestly in slot_occupancy.
     engine_harvest_every: int = 4
+    # Replicated-engine decode fleet (parallel/fleet.py; docs/MULTICHIP.md):
+    # N SlotEngine replicas — one per device/data-mesh slice, each with its
+    # own per-chip KV arena and compiled program set — pull packed chunks
+    # from ONE shared admission queue, with harvest/refill interleaved
+    # across replicas. 1 = the single-engine path, byte-identical behavior.
+    # A nonzero engine_slots is the fleet-TOTAL arena and must divide by
+    # the replica count (validated at parse time, exit 2); engine_slots=0
+    # keeps the per-replica default (test_batch_size slots EACH). Decoded
+    # file bytes are invariant to the replica count and to refill
+    # interleaving (tests/test_fleet.py).
+    engine_replicas: int = 1
 
     # --- typed edges (beyond-parity extension) ---
     # The reference computes six edge families then flattens them into one

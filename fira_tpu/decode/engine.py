@@ -61,6 +61,17 @@ refills every freed slot, steps, harvests settled slots, and yields one
 ordered streaming writer, decode/stream.py, restores order on disk). The
 per-dispatch ``done`` readback is the engine's designated sync boundary:
 the refill decision is host-side by construction.
+
+The scheduler is exposed as STEPPABLE pieces — ``begin_stream`` /
+``wants_input`` / ``admit`` / ``refill`` / ``step_dispatch`` / ``harvest``
+— and ``run()`` is just the single-engine loop over them. The replicated
+decode fleet (parallel/fleet.py) round-robins the SAME pieces over N
+engine instances pulling from one shared admission queue, so the fleet
+inherits the single engine's scheduling semantics (and its per-sample
+bit-exactness) by construction instead of re-implementing them.
+``device``/``tag`` pin a replica to its own chip and suffix its guard
+labels (``engine_step[r0]``), keeping the one-compile-per-label contract
+honest when N replicas each compile their own program set.
 """
 
 from __future__ import annotations
@@ -156,10 +167,15 @@ class SlotEngine:
     is also what the bit-exactness golden tests pin). ``guard``: an armed
     analysis.sanitizer.CompileGuard; every dispatch is labelled, so the
     one-compile-per-label contract covers the whole engine family.
+    ``device``: pin the arena, params inputs, and every admitted chunk to
+    ONE device (a fleet replica's chip); None keeps the default placement.
+    ``tag``: label suffix (the fleet's ``r<i>``) so each replica's own
+    compiles stay one-per-label under the guard.
     """
 
     def __init__(self, model: FiraModel, params, cfg: FiraConfig, *,
-                 slots: Optional[int] = None, guard=None):
+                 slots: Optional[int] = None, guard=None,
+                 device=None, tag: Optional[str] = None):
         self.model = model
         self.params = params
         self.cfg = cfg
@@ -167,6 +183,8 @@ class SlotEngine:
         if self.slots < 1:
             raise ValueError(f"engine needs >= 1 slot, got {self.slots}")
         self.guard = guard
+        self.device = device
+        self.tag = tag
         self.stats = EngineStats(slots=self.slots)
         self._state = None
         self._prefill = jax.jit(self._prefill_fn)
@@ -174,6 +192,27 @@ class SlotEngine:
         # holds exactly one live state, rebound on every dispatch
         self._step = jax.jit(self._step_fn, donate_argnums=(1,))
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
+        self._pending_occ = None
+        self.begin_stream()
+
+    def label(self, kind: str, geom_tag: Optional[str] = None) -> str:
+        """Guard label for one of THIS engine's programs: the geometry tag
+        (prefill family) and the replica tag compose into the standard
+        ``program_label`` format — ``engine_prefill[a16.e256.t12.r1]``,
+        ``engine_step[r1]``; with no tag the single-engine labels are
+        unchanged."""
+        mods = ".".join(t for t in (geom_tag, self.tag) if t)
+        return program_label(kind, mods or None)
+
+    def labels(self, table=None) -> List[str]:
+        """This engine's full declared program family: one prefill label
+        per decode bucket geometry (or the untagged prefill when no table)
+        plus step + insert."""
+        from fira_tpu.data.buckets import geom_tag
+
+        prefills = ([self.label(PREFILL_KIND, geom_tag(g)) for g in table]
+                    if table is not None else [self.label(PREFILL_KIND)])
+        return prefills + [self.label(STEP_LABEL), self.label(INSERT_LABEL)]
 
     # --- jitted programs -------------------------------------------------
 
@@ -409,7 +448,7 @@ class SlotEngine:
         else:
             st = chunk["states"]
             z["states"] = np.zeros((S * K,) + st.shape[1:], st.dtype)
-        self._state = jax.device_put(z)
+        self._state = jax.device_put(z, self.device)
 
     # --- host scheduler --------------------------------------------------
 
@@ -425,9 +464,111 @@ class SlotEngine:
         warmup compile at their natural first dispatch."""
         for host, tag in warm_batches:
             wire = {k: v for k, v in host.items() if not k.startswith("_")}
-            chunk = self._prefill(self.params, wire)
-            self._guard_step(program_label(PREFILL_KIND, tag))
+            chunk = self._prefill(self.params,
+                                  jax.device_put(wire, self.device))
+            self._guard_step(self.label(PREFILL_KIND, tag))
             self._ensure_state(chunk)
+
+    # --- steppable scheduler pieces (the fleet round-robins these) -------
+
+    def begin_stream(self) -> None:
+        """Reset the host-side scheduling state for a fresh input stream
+        (the slot arena and stats persist — stats accumulate across runs,
+        exactly as before the scheduler was made steppable)."""
+        self._staged: "collections.deque[_Staged]" = collections.deque()
+        self._staged_rows = 0
+        self._free: List[int] = list(range(self.slots))
+        self._busy: Dict[int, Tuple[int, Dict, int]] = {}
+
+    def wants_input(self) -> bool:
+        """Prefill-ahead policy: keep ``engine_prefill_depth`` chunks
+        staged, and at least enough rows to refill every free slot."""
+        depth = max(1, int(self.cfg.engine_prefill_depth))
+        return (len(self._staged) < depth
+                or self._staged_rows < len(self._free))
+
+    def in_flight(self) -> int:
+        return len(self._busy)
+
+    def admit(self, host: Dict, index: int, device_batch=None) -> None:
+        """Prefill one packed batch and stage its real rows for refill.
+        ``device_batch``: the feeder's already-transferred wire batch;
+        None (or an engine pinned to its own device — a fleet replica
+        cannot use a chunk committed elsewhere) re-ships the host batch,
+        stripping the "_"-prefixed host-only fields exactly like the
+        feeder does."""
+        if device_batch is None or self.device is not None:
+            wire = {k: v for k, v in host.items() if not k.startswith("_")}
+            device_batch = jax.device_put(wire, self.device)
+        chunk = self._prefill(self.params, device_batch)
+        self._guard_step(self.label(PREFILL_KIND, host.get("_tag")))
+        self._ensure_state(chunk)
+        self.stats.prefills += 1
+        positions = host.get("_positions")  # bucketed stream only
+        valid = host["valid"]
+        rows: "collections.deque[Tuple[int, int]]" = collections.deque()
+        C = valid.shape[0]
+        for r in range(C):
+            if not valid[r]:
+                continue
+            pos_id = (int(positions[r]) if positions is not None  # firacheck: allow[HOST-SYNC] _positions is a host-only numpy field (feeder strips it from the wire); no device value exists here
+                      else index * C + r)
+            rows.append((r, pos_id))
+        if rows:
+            self._staged.append(_Staged(chunk=chunk, host=host, rows=rows))
+            self._staged_rows += len(rows)
+
+    def refill(self, refill_order: str = "fifo") -> None:
+        """Insert staged rows into every free slot (one insert dispatch
+        per staged chunk touched)."""
+        while self._free and self._staged:
+            entry = self._staged[0]
+            C = entry.host["valid"].shape[0]
+            slot_ids = np.full((C,), self.slots, dtype=np.int32)  # S = drop
+            n_ins = 0
+            while self._free and entry.rows:
+                r, pos_id = entry.rows.popleft()
+                slot = (self._free.pop(0) if refill_order == "fifo"
+                        else self._free.pop())
+                slot_ids[r] = slot
+                self._busy[slot] = (pos_id, entry.host, r)
+                n_ins += 1
+            self._state = self._insert(self._state, entry.chunk, slot_ids)
+            self._guard_step(self.label(INSERT_LABEL))
+            self.stats.refills += 1
+            self.stats.slots_refilled += n_ins
+            self._staged_rows -= n_ins
+            if not entry.rows:
+                self._staged.popleft()
+
+    def step_dispatch(self) -> None:
+        """Dispatch one step program (async — the fleet dispatches every
+        replica's step before any harvest readback, so replica compute
+        overlaps across chips)."""
+        self._state, self._pending_occ = self._step(self.params, self._state)
+        self._guard_step(self.label(STEP_LABEL))
+        self.stats.step_dispatches += 1
+        self.stats.steps += max(1, int(self.cfg.engine_harvest_every))
+
+    def harvest(self) -> Iterator[EngineItem]:
+        """Read back the dispatched step's done mask and yield every newly
+        settled slot's sample. COPIES, not views: the next dispatch DONATES
+        these buffers, and on the CPU backend a zero-copy device_get view
+        into a donated buffer dangles."""
+        stats = self.stats
+        stats.occupied_slot_steps += int(np.array(
+            jax.device_get(self._pending_occ)))
+        done = np.array(jax.device_get(self._state["done"]))
+        newly = [s for s in self._busy if done[s]]
+        if newly:
+            toks = np.array(jax.device_get(self._state["tokens"]))
+            probs = np.array(jax.device_get(self._state["probs"]))
+            for s in newly:
+                pos_id, host, r = self._busy.pop(s)
+                self._free.append(s)
+                stats.commits += 1
+                yield EngineItem(position=pos_id, host=host, row=r,
+                                 tokens=toks[s], probs=probs[s])
 
     def run(self, feed, *, refill_order: str = "fifo"
             ) -> Iterator[EngineItem]:
@@ -448,90 +589,31 @@ class SlotEngine:
         if refill_order not in ("fifo", "lifo"):
             raise ValueError(f"refill_order {refill_order!r} not in "
                              f"{{'fifo', 'lifo'}}")
-        cfg = self.cfg
-        S = self.slots
-        depth = max(1, int(cfg.engine_prefill_depth))
-        cadence = max(1, int(cfg.engine_harvest_every))
-        stats = self.stats
+        self.begin_stream()
         feed_iter = iter(feed)
-        staged: "collections.deque[_Staged]" = collections.deque()
-        staged_rows = 0
-        free: List[int] = list(range(S))
-        busy: Dict[int, Tuple[int, Dict, int]] = {}
         exhausted = False
 
         while True:
             # prefill ahead: keep `depth` chunks staged, and at least
             # enough rows to refill every currently free slot
-            while not exhausted and (len(staged) < depth
-                                     or staged_rows < len(free)):
+            while not exhausted and self.wants_input():
                 try:
                     item = next(feed_iter)
                 except StopIteration:
                     exhausted = True
                     break
-                chunk = self._prefill(self.params, item.device)
-                self._guard_step(program_label(PREFILL_KIND,
-                                               item.host.get("_tag")))
-                self._ensure_state(chunk)
-                stats.prefills += 1
-                positions = item.host.get("_positions")  # bucketed stream only
-                valid = item.host["valid"]
-                rows: "collections.deque[Tuple[int, int]]" = collections.deque()
-                C = valid.shape[0]
-                for r in range(C):
-                    if not valid[r]:
-                        continue
-                    pos_id = (int(positions[r]) if positions is not None  # firacheck: allow[HOST-SYNC] _positions is a host-only numpy field (feeder strips it from the wire); no device value exists here
-                              else item.index * C + r)
-                    rows.append((r, pos_id))
-                if rows:
-                    staged.append(_Staged(chunk=chunk, host=item.host,
-                                          rows=rows))
-                    staged_rows += len(rows)
+                # a put=False feed (the fleet's shared queue) leaves
+                # item.device == item.host; admit re-ships it then
+                self.admit(item.host, item.index,
+                           None if item.device is item.host else item.device)
 
             # refill every free slot from the staged queue
-            while free and staged:
-                entry = staged[0]
-                C = entry.host["valid"].shape[0]
-                slot_ids = np.full((C,), S, dtype=np.int32)  # S = drop
-                n_ins = 0
-                while free and entry.rows:
-                    r, pos_id = entry.rows.popleft()
-                    slot = (free.pop(0) if refill_order == "fifo"
-                            else free.pop())
-                    slot_ids[r] = slot
-                    busy[slot] = (pos_id, entry.host, r)
-                    n_ins += 1
-                self._state = self._insert(self._state, entry.chunk, slot_ids)
-                self._guard_step(INSERT_LABEL)
-                stats.refills += 1
-                stats.slots_refilled += n_ins
-                staged_rows -= n_ins
-                if not entry.rows:
-                    staged.popleft()
+            self.refill(refill_order)
 
-            if not busy:
+            if not self._busy:
                 if exhausted:
                     break
                 continue  # nothing in flight yet: pull more input
 
-            self._state, occ = self._step(self.params, self._state)
-            self._guard_step(STEP_LABEL)
-            stats.step_dispatches += 1
-            stats.steps += cadence
-            # COPIES, not views: the next dispatch DONATES these buffers,
-            # and on the CPU backend a zero-copy device_get view into a
-            # donated buffer dangles
-            stats.occupied_slot_steps += int(np.array(jax.device_get(occ)))  # firacheck: allow[HOST-SYNC] per-dispatch harvest is the engine's designated sync boundary: the refill decision is host-side by construction
-            done = np.array(jax.device_get(self._state["done"]))  # firacheck: allow[HOST-SYNC] same harvest boundary as the line above
-            newly = [s for s in busy if done[s]]
-            if newly:
-                toks = np.array(jax.device_get(self._state["tokens"]))  # firacheck: allow[HOST-SYNC] same harvest boundary: settled beams must reach the host to be cooked into text
-                probs = np.array(jax.device_get(self._state["probs"]))  # firacheck: allow[HOST-SYNC] same harvest boundary as the line above
-                for s in newly:
-                    pos_id, host, r = busy.pop(s)
-                    free.append(s)
-                    stats.commits += 1
-                    yield EngineItem(position=pos_id, host=host, row=r,
-                                     tokens=toks[s], probs=probs[s])
+            self.step_dispatch()
+            yield from self.harvest()

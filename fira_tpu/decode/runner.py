@@ -13,6 +13,9 @@ bit-exact per sample — docs/DECODE_ENGINE.md):
 - **slot-refill engine** (decode/engine.py): S static slots advanced one
   token per step, settled slots harvested and refilled mid-flight from
   the same packer stream — wall clock scales with total tokens emitted.
+  With ``cfg.engine_replicas > 1`` the engine becomes a replicated FLEET
+  (parallel/fleet.py): N engines on N devices pull from one shared
+  admission queue; decoded file bytes are invariant to the replica count.
 
 Both paths stream through the ordered writer (decode/stream.py): the
 contiguous split-order prefix is on disk the moment it completes, a crash
@@ -91,7 +94,7 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
 
     os.makedirs(out_dir, exist_ok=True)
     out_path = os.path.join(out_dir, output_name(ablation))
-    total_bleu, n = 0.0, 0
+    bleu_by_pos: Dict[int, float] = {}
     n_total = len(data)
     engine_stats = None
 
@@ -101,7 +104,6 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
         split position."""
 
         def emit(pos, host, row, tokens, probs):
-            nonlocal total_bleu, n
             best = int(np.argmax(probs))             # run_model.py:351
             ids = tokens[best].tolist()
             # beam output ids are already copy-resolved at extension time
@@ -109,8 +111,12 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
                                   host["sub_token"][row], vocab, cfg,
                                   resolve=False)
             ref = reference_words(host["msg"][row], vocab)
-            total_bleu += nltk_sentence_bleu([ref], hyp)
-            n += 1
+            # keyed by position, summed in split order at the end: samples
+            # settle in scheduler order (engine/fleet), and float addition
+            # in settle order would make the aggregate depend on replica
+            # count / refill interleaving in the last ulp
+            bleu_by_pos[pos] = nltk_sentence_bleu([ref], hyp)
+            n = len(bleu_by_pos)
             var_map = (var_maps[indices[pos]]
                        if var_maps is not None else None)
             writer.add(pos, " ".join(deanonymize(hyp, var_map)) + "\n")
@@ -121,26 +127,38 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
         return emit
 
     if cfg.decode_engine:
-        eng = engine_lib.SlotEngine(model, params, cfg, slots=engine_slots,
-                                    guard=guard)
+        n_rep = max(1, int(cfg.engine_replicas))
+        if n_rep > 1:
+            from fira_tpu.parallel import fleet as fleet_lib
+
+            eng = fleet_lib.EngineFleet(model, params, cfg, replicas=n_rep,
+                                        slots=engine_slots, guard=guard)
+        else:
+            eng = engine_lib.SlotEngine(model, params, cfg,
+                                        slots=engine_slots, guard=guard)
         if table is not None:
             if guard is not None:
-                guard.declare(
-                    [program_label(engine_lib.PREFILL_KIND,
-                                   buckets_lib.geom_tag(g)) for g in table]
-                    + [engine_lib.STEP_LABEL, engine_lib.INSERT_LABEL])
+                # single engine: the classic (geometry x {prefill, step,
+                # insert}) family; fleet: the union over replicas, each
+                # label suffixed r<i> (per-device executables are real
+                # per-replica compiles)
+                guard.declare(eng.labels(table))
             eng.prewarm(
                 (buckets_lib.warmup_batch(data, cfg, g, cfg.test_batch_size),
                  buckets_lib.geom_tag(g)) for g in table)
             print(f"decode buckets: {len(table)} engine prefill programs "
-                  f"pre-warmed "
+                  f"pre-warmed"
+                  f"{f' x {n_rep} replicas' if n_rep > 1 else ''} "
                   f"({', '.join(buckets_lib.geom_tag(g) for g in table)})",
                   flush=True)
         # the Feeder is constructed INSIDE the with (after the writer's
-        # open succeeds): a failing open must not leak worker threads
+        # open succeeds): a failing open must not leak worker threads.
+        # The fleet's feeder skips the device_put (put=False): which
+        # replica a chunk lands on is a scheduling decision, so the
+        # transfer happens at admission, onto the claiming replica's chip.
         with OrderedStreamWriter(out_path, expected=n_total) as writer, \
                 Feeder(tasks, num_workers=cfg.feeder_workers,
-                       depth=cfg.feeder_depth) as feed:
+                       depth=cfg.feeder_depth, put=n_rep == 1) as feed:
             emit = make_emit(writer)
             for item in eng.run(feed, refill_order=refill_order):
                 emit(item.position, item.host, item.row, item.tokens,
@@ -187,6 +205,8 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
                     pos = cursor if positions is None else int(positions[i])  # firacheck: allow[HOST-SYNC] _positions is a host-only numpy field (feeder strips it from the wire); no device value exists here
                     emit(pos, batch, i, tokens[i], probs[i])
                     cursor += 1
+    n = len(bleu_by_pos)
+    total_bleu = sum(bleu_by_pos[p] for p in sorted(bleu_by_pos))
     out: Dict[str, float] = {
         "sentence_bleu": total_bleu / max(n, 1), "n": float(n),
         "output_path": out_path}  # type: ignore[assignment]

@@ -1,0 +1,193 @@
+"""Replicated slot-engine decode fleet: N engines, one admission queue.
+
+The slot-refill engine (decode/engine.py) made decode wall clock scale
+with tokens emitted — on ONE chip. This module is the multi-chip half of
+that story (ROADMAP item 3; Orca's iteration-level scheduling generalized
+to a serving fleet, PAPERS.md "Continuous batching / inference serving"):
+N :class:`~fira_tpu.decode.engine.SlotEngine` replicas — one per
+data-mesh slice, each with its own per-chip KV arena, params copy, and
+compiled program set — pull packed chunks from ONE shared admission queue
+(the async feeder stream every decode driver already uses) and
+harvest/refill interleave across replicas.
+
+Scheduling is the single engine's own steppable scheduler, round-robined:
+
+- **admission**: replicas claim chunks from the shared queue in replica
+  order whenever their prefill-ahead policy wants input (same
+  ``engine_prefill_depth`` staging per replica). The feeder runs
+  ``put=False`` — which replica a chunk lands on is a scheduling
+  decision, so the H2D transfer happens at admission, onto the claiming
+  replica's own device.
+- **step interleave**: every live replica's step program is dispatched
+  BEFORE any replica's harvest readback, so replica compute overlaps
+  across chips while the host walks the fleet.
+- **harvest/refill**: each replica harvests its settled slots (yielding
+  :class:`~fira_tpu.decode.engine.EngineItem` exactly like the single
+  engine) and refills from its staged chunks on the next round.
+
+Output invariance (pinned by tests/test_fleet.py): per-sample results are
+bit-exact regardless of which replica/slot computes them — same params,
+same prefill batches (a chunk is always prefilled WHOLE, wherever it
+lands), same per-slot step math — so the decoded file bytes are identical
+to the single-engine path for ANY replica count and refill interleaving.
+
+Guard labels: each replica suffixes its labels with ``r<i>``
+(``engine_step[r1]``, ``engine_prefill[a16.e256.t12.r1]``) because each
+replica compiles its own program set (per-device executables); the
+declared family is the union over replicas (:meth:`EngineFleet.labels`)
+and still closes at one compile per label.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import jax
+
+from fira_tpu.config import FiraConfig
+from fira_tpu.decode.engine import EngineItem, EngineStats, SlotEngine
+from fira_tpu.model.model import FiraModel
+
+
+def fleet_divisibility_errors(cfg: FiraConfig) -> List[str]:
+    """Parse-time fleet admission check (the decode twin of
+    parallel.mesh.divisibility_errors): a nonzero ``engine_slots`` is the
+    fleet-TOTAL arena, split evenly across replicas — reject a non-divisor
+    up front instead of failing in the arena allocation mid-run."""
+    reps = max(1, int(cfg.engine_replicas))
+    if reps > 1 and cfg.engine_slots and cfg.engine_slots % reps:
+        return [f"engine_slots {cfg.engine_slots} is not divisible by "
+                f"engine_replicas {reps} (the fleet splits the total slot "
+                f"arena evenly across replicas)"]
+    return []
+
+
+@dataclasses.dataclass
+class FleetStats:
+    """Aggregate + per-replica accounting for one fleet run."""
+
+    replicas: List[EngineStats]
+
+    @property
+    def commits(self) -> int:
+        return sum(r.commits for r in self.replicas)
+
+    def summary(self) -> Dict:
+        tot = lambda f: sum(getattr(r, f) for r in self.replicas)  # noqa: E731
+        steps_x_slots = sum(r.steps * r.slots for r in self.replicas)
+        return {
+            "replicas": len(self.replicas),
+            "slots": tot("slots"),
+            "prefills": tot("prefills"),
+            "refills": tot("refills"),
+            "slots_refilled": tot("slots_refilled"),
+            "steps_run": tot("steps"),
+            "step_dispatches": tot("step_dispatches"),
+            "commits": self.commits,
+            "dispatches": sum(r.dispatches for r in self.replicas),
+            # fleet-wide mean fraction of slots doing real beam work
+            "slot_occupancy": round(
+                tot("occupied_slot_steps") / steps_x_slots, 4
+            ) if steps_x_slots else 0.0,
+            "per_replica_occupancy": [
+                round(r.slot_occupancy, 4) for r in self.replicas],
+            "per_replica_commits": [r.commits for r in self.replicas],
+        }
+
+
+class EngineFleet:
+    """N-replica slot-engine decode over one shared admission queue.
+
+    ``replicas``: engine count. ``slots``: fleet-TOTAL arena (must divide
+    by ``replicas``); 0/None falls back to each replica's own default
+    (``cfg.engine_slots`` total when nonzero, else ``cfg.test_batch_size``
+    slots PER replica). ``devices``: one device per replica; defaults to
+    ``jax.devices()`` round-robin, so on an N-device mesh each replica
+    owns its own chip and on a single chip the replicas share it (still
+    output-identical — the tests pin exactly that).
+    """
+
+    def __init__(self, model: FiraModel, params, cfg: FiraConfig, *,
+                 replicas: int, slots: Optional[int] = None, guard=None,
+                 devices: Optional[Sequence] = None):
+        if replicas < 1:
+            raise ValueError(f"fleet needs >= 1 replica, got {replicas}")
+        total = int(slots or cfg.engine_slots or 0)
+        if total and total % replicas:
+            raise ValueError(
+                f"engine_slots {total} is not divisible by engine_replicas "
+                f"{replicas} (the fleet splits the total slot arena evenly "
+                f"across replicas)")
+        per_replica = total // replicas if total else None
+        if devices is None:
+            devs = jax.devices()
+            devices = [devs[i % len(devs)] for i in range(replicas)]
+        elif len(devices) < replicas:
+            raise ValueError(f"{len(devices)} devices for {replicas} "
+                             f"replicas")
+        self.cfg = cfg
+        self.engines = [
+            SlotEngine(model, jax.device_put(params, devices[i]), cfg,
+                       slots=per_replica, guard=guard, device=devices[i],
+                       tag=f"r{i}")
+            for i in range(replicas)
+        ]
+
+    @property
+    def stats(self) -> FleetStats:
+        return FleetStats([e.stats for e in self.engines])
+
+    def labels(self, table=None) -> List[str]:
+        """The fleet's declared program family: the union of every
+        replica's (geometry x {prefill, step, insert}) labels."""
+        return [lbl for e in self.engines for lbl in e.labels(table)]
+
+    def prewarm(self, warm_batches) -> None:
+        """Compile every replica's prefill family up front (each replica
+        owns its own executables — per-device compiles are real compiles,
+        and the guard budget prices them per replica label)."""
+        batches = list(warm_batches)
+        for eng in self.engines:
+            eng.prewarm(batches)
+
+    def run(self, feed, *, refill_order: str = "fifo"
+            ) -> Iterator[EngineItem]:
+        """Drive the fleet over ``feed`` (data.feeder.FedBatch items from
+        a ``put=False`` feeder — the shared admission queue). Yields one
+        EngineItem per real sample as it settles, across all replicas;
+        results are keyed by split position, so the ordered writer
+        downstream is replica-agnostic."""
+        if refill_order not in ("fifo", "lifo"):
+            raise ValueError(f"refill_order {refill_order!r} not in "
+                             f"{{'fifo', 'lifo'}}")
+        for eng in self.engines:
+            eng.begin_stream()
+        feed_iter = iter(feed)
+        exhausted = False
+        while True:
+            # admission + refill, replica order (deterministic: which
+            # replica gets a chunk never changes the chunk's results)
+            for eng in self.engines:
+                while not exhausted and eng.wants_input():
+                    try:
+                        item = next(feed_iter)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    eng.admit(item.host, item.index,
+                              None if item.device is item.host
+                              else item.device)
+                eng.refill(refill_order)
+            live = [eng for eng in self.engines if eng.in_flight()]
+            if not live:
+                if exhausted:
+                    return
+                continue  # nothing in flight yet: pull more input
+            # dispatch EVERY live replica's step before any harvest
+            # readback: replica compute overlaps across chips while the
+            # host walks the fleet
+            for eng in live:
+                eng.step_dispatch()
+            for eng in live:
+                yield from eng.harvest()

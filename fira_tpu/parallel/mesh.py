@@ -22,7 +22,7 @@ normalization (run_model.py:104-105).
 from __future__ import annotations
 
 import re
-from typing import Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -102,6 +102,51 @@ def stacked_batch_shardings(stacked_batch, mesh: Mesh):
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def feed_shardings(mesh: Optional[Mesh]):
+    """Feeder ``sharding=`` callable for the grouped/bucketed train stream.
+
+    Mixed-geometry streams pick their sharding by SHAPE, not by bucket
+    identity: a K-stacked group (2-D ``valid``) shards axis 1 on the data
+    axis with the scan/step axis replicated, a per-step batch shards axis
+    0 — so ONE callable covers every member of the (geometry x K) program
+    family, and each K-group ships as a single worker-side sharded
+    ``device_put``. ``mesh=None`` returns None (the feeder's single-chip
+    default placement), so drivers can pass this unconditionally."""
+    if mesh is None:
+        return None
+
+    def shardings(batch):
+        if batch["valid"].ndim == 2:  # K-stacked group (fused/accum)
+            return stacked_batch_shardings(batch, mesh)
+        return batch_shardings(batch, mesh)
+
+    return shardings
+
+
+def divisibility_errors(cfg, n_data: int) -> List[str]:
+    """Parse-time mesh admission check: every dispatched train batch
+    shards its batch axis over the ``data`` mesh axis, so each bucket's
+    batch size must divide by ``n_data`` — otherwise the run dies mid-epoch
+    in an XLA reshape/sharding error long after startup. Returns one named
+    message per offending bucket (all buckets dispatch at ``cfg.batch_size``
+    today, but the check prices each declared geometry so a future
+    per-bucket batch size cannot silently regress the guarantee). The
+    engine fleet's twin (engine_slots vs replica count) lives with the
+    fleet (parallel/fleet.py)."""
+    errs: List[str] = []
+    if n_data <= 1:
+        return errs
+    from fira_tpu.data.buckets import bucket_table, geom_tag
+
+    for geom in bucket_table(cfg):
+        if cfg.batch_size % n_data:
+            errs.append(
+                f"bucket {geom_tag(geom)}: batch_size {cfg.batch_size} is "
+                f"not divisible by the mesh's data axis (n_data={n_data}); "
+                f"every dispatched batch shards rows over that axis")
+    return errs
 
 
 def shard_batch(batch, mesh: Mesh):

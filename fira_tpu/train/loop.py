@@ -189,6 +189,14 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
     import jax.numpy as jnp
 
     cfg = cfg or dataset.cfg  # dataset.cfg has vocab sizes filled in
+    if mesh is not None:
+        # fail BEFORE any compile: a batch axis that doesn't divide the
+        # data mesh axis otherwise dies mid-epoch in an XLA sharding error
+        # (the CLI runs the same check at parse time and exits 2)
+        errs = pmesh.divisibility_errors(cfg,
+                                         mesh.shape[pmesh.DATA_AXIS])
+        if errs:
+            raise ValueError("mesh divisibility: " + "; ".join(errs))
     log = TrainLog(out_dir)
     model = FiraModel(cfg, dtype=dtype or jnp.dtype(cfg.compute_dtype))
 
@@ -244,14 +252,13 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
     profile_done = False
     global_step = 0
 
-    # Double-buffered device feed: batch i+1 transfers while step i runs
-    # (with a mesh, batches land pre-sharded along the data axis).
-    def batch_sharding(b):
-        if mesh is None:
-            return None
-        if b["valid"].ndim == 2:  # K-stacked group (fused device loop)
-            return pmesh.stacked_batch_shardings(b, mesh)
-        return pmesh.batch_shardings(b, mesh)
+    # Double-buffered device feed: batch i+1 transfers while step i runs.
+    # With a mesh, batches land pre-sharded along the data axis — the
+    # shared shape-dispatched callable (parallel.mesh.feed_shardings)
+    # picks stacked vs per-batch shardings per item, so mixed-geometry
+    # bucketed streams and K-groups both ship correctly sharded from the
+    # feeder's workers.
+    batch_sharding = pmesh.feed_shardings(mesh)
 
     # Grouped device programs — mutually exclusive:
     #   fused_steps K   > 1: K-groups run as K steps in ONE lax.scan dispatch

@@ -1,0 +1,168 @@
+"""Replicated slot-engine decode fleet (parallel/fleet.py).
+
+Pins the fleet's contract (ISSUE 6 / docs/MULTICHIP.md):
+
+- output file bytes (and BLEU) identical to the SINGLE-engine path for
+  any replica count and refill interleaving — the per-sample bit-exactness
+  argument composed over the fleet's replica-agnostic scheduling;
+- zero post-warmup compiles under the declared per-replica
+  (geometry x {prefill, step, insert} x replica) family;
+- every replica does real work on a multi-chunk stream, replicas own
+  DISTINCT devices on the virtual multi-device mesh, and the aggregate /
+  per-replica stats add up;
+- fleet-total engine_slots must divide the replica count (the parse-time
+  divisibility contract).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from fira_tpu.analysis import sanitizer
+from fira_tpu.config import fira_tiny
+from fira_tpu.data.dataset import FiraDataset
+from fira_tpu.data.feeder import Feeder
+from fira_tpu.data.synthetic import write_corpus_dir
+from fira_tpu.decode.beam import eos_biased_params
+from fira_tpu.decode.runner import run_test
+from fira_tpu.model.model import FiraModel
+from fira_tpu.parallel import fleet as fleet_lib
+from fira_tpu.train.state import init_state
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    data_dir = str(tmp_path_factory.mktemp("fleet_corpus"))
+    write_corpus_dir(data_dir, n_commits=36, seed=17)
+    cfg = fira_tiny(batch_size=8, test_batch_size=6)
+    dataset = FiraDataset(data_dir, cfg)
+    cfg = dataset.cfg
+    from fira_tpu.data.batching import make_batch
+
+    batch = make_batch(dataset.splits["train"], np.arange(6), cfg)
+    params = init_state(FiraModel(cfg), cfg, batch).params
+    # moderate EOS bias: mixed settle depths, the schedule refill exists for
+    return cfg, dataset, eos_biased_params(params, delta=4.0)
+
+
+@pytest.fixture(scope="module")
+def single_engine_output(setup, tmp_path_factory):
+    """The single-engine reference decode of the train split (engine path,
+    replicas=1) — every fleet variant below must reproduce its file bytes."""
+    cfg, dataset, params = setup
+    out = str(tmp_path_factory.mktemp("single"))
+    metrics = run_test(FiraModel(cfg), params, dataset,
+                       cfg.replace(decode_engine=True),
+                       out_dir=out, split="train")
+    return metrics, open(metrics["output_path"], "rb").read()
+
+
+def test_fleet_file_bytes_invariant_to_replica_count(setup,
+                                                     single_engine_output,
+                                                     tmp_path):
+    cfg, dataset, params = setup
+    ref_metrics, ref_bytes = single_engine_output
+    for n_rep in (2, 3):
+        out = str(tmp_path / f"rep{n_rep}")
+        metrics = run_test(
+            FiraModel(cfg), params, dataset,
+            cfg.replace(decode_engine=True, engine_replicas=n_rep),
+            out_dir=out, split="train")
+        assert open(metrics["output_path"], "rb").read() == ref_bytes
+        assert metrics["sentence_bleu"] == ref_metrics["sentence_bleu"]
+        eng = metrics["engine"]
+        assert eng["replicas"] == n_rep
+        assert eng["commits"] == len(dataset.splits["train"])
+
+
+def test_fleet_refill_interleaving_invariance(setup, single_engine_output,
+                                              tmp_path):
+    """File bytes survive every scheduling perturbation: refill order
+    (fifo/lifo) and prefill-queue depth on a 2-replica fleet."""
+    cfg, dataset, params = setup
+    _, ref_bytes = single_engine_output
+    for i, (order, depth) in enumerate([("lifo", 2), ("fifo", 1)]):
+        out = str(tmp_path / f"v{i}")
+        metrics = run_test(
+            FiraModel(cfg), params, dataset,
+            cfg.replace(decode_engine=True, engine_replicas=2,
+                        engine_prefill_depth=depth),
+            out_dir=out, split="train", refill_order=order)
+        assert open(metrics["output_path"], "rb").read() == ref_bytes
+
+
+def test_fleet_bucketed_zero_retraces_and_file_identical(
+        setup, tmp_path):
+    """Bucketed stream through a 2-replica fleet under the armed
+    sanitizer: the declared per-replica family warms once, then zero
+    post-warmup compiles — and the bytes still match the single engine
+    on the same bucketed stream."""
+    cfg0, dataset, params = setup
+    cfg = dataclasses.replace(cfg0, buckets=((16, 400, 12),))
+    model = FiraModel(cfg)
+    ref = run_test(model, params, dataset,
+                   dataclasses.replace(cfg, decode_engine=True),
+                   out_dir=str(tmp_path / "one"), split="train")
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        got = run_test(model, params, dataset,
+                       dataclasses.replace(cfg, decode_engine=True,
+                                           engine_replicas=2),
+                       out_dir=str(tmp_path / "two"), guard=guard,
+                       split="train")
+        assert guard.compiles_after_warmup() == 0
+    assert (open(got["output_path"], "rb").read()
+            == open(ref["output_path"], "rb").read())
+    seen = set(guard._seen)
+    # per-replica labels: each replica's prefill family + step + insert
+    for r in ("r0", "r1"):
+        assert any(lbl.startswith("engine_prefill[") and f".{r}]" in lbl
+                   for lbl in seen), seen
+        assert f"engine_step[{r}]" in seen
+        assert f"engine_insert[{r}]" in seen
+    # an undeclared replica raises at its dispatch
+    with pytest.raises(sanitizer.RetraceError, match="declared"):
+        guard.step("engine_step[r9]")
+
+
+def test_fleet_replicas_work_on_distinct_devices(setup):
+    """On the virtual 8-device CPU mesh each replica owns its own chip:
+    the slot arenas live on DISTINCT devices, every replica commits work
+    from the shared queue, and the aggregate stats add up."""
+    cfg, dataset, params = setup
+    assert len(jax.devices()) >= 2
+    data = dataset.splits["train"]
+    model = FiraModel(cfg)
+    fleet = fleet_lib.EngineFleet(model, params, cfg, replicas=2)
+    from fira_tpu.decode.runner import _decode_tasks
+
+    tasks, _ = _decode_tasks(data, cfg)
+    with Feeder(tasks, num_workers=0, depth=1, put=False) as feed:
+        positions = sorted(it.position for it in fleet.run(feed))
+    assert positions == list(range(len(data)))
+    devs = [next(iter(eng._state["tokens"].devices()))
+            for eng in fleet.engines]
+    assert devs[0] != devs[1]
+    s = fleet.stats.summary()
+    assert s["commits"] == len(data) == sum(s["per_replica_commits"])
+    assert all(c > 0 for c in s["per_replica_commits"]), s
+    assert all(0.0 < o <= 1.0 for o in s["per_replica_occupancy"]), s
+    assert s["slots"] == sum(e.slots for e in fleet.engines)
+
+
+def test_fleet_slots_total_divisibility(setup):
+    cfg, _dataset, params = setup
+    model = FiraModel(cfg)
+    with pytest.raises(ValueError, match="divisible"):
+        fleet_lib.EngineFleet(model, params, cfg, replicas=3, slots=8)
+    # 8 total over 2 replicas = 4 each
+    fleet = fleet_lib.EngineFleet(model, params, cfg, replicas=2, slots=8)
+    assert [e.slots for e in fleet.engines] == [4, 4]
+    # the parse-time twin the CLI exits 2 on
+    errs = fleet_lib.fleet_divisibility_errors(
+        cfg.replace(decode_engine=True, engine_replicas=3, engine_slots=8))
+    assert errs and "divisible" in errs[0]
+    assert not fleet_lib.fleet_divisibility_errors(
+        cfg.replace(decode_engine=True, engine_replicas=2, engine_slots=8))
