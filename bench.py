@@ -728,7 +728,14 @@ def worker() -> None:
                 "early_exit_steps_run": batch_steps,
                 **{k: st[k] for k in ("slots", "slot_occupancy",
                                       "steps_run", "refills",
-                                      "steps_per_commit", "dispatches")},
+                                      "steps_per_commit", "dispatches",
+                                      # paged-KV HBM accounting
+                                      # (decode/paging.py): the machine-
+                                      # recorded side of any paged-vs-
+                                      # unpaged memory claim
+                                      "pool_blocks", "kv_block_size",
+                                      "kv_bytes_per_slot", "peak_blocks",
+                                      "pool_utilization")},
             }
         except Exception as e:
             print(f"decode engine leg failed: {e!r}", file=sys.stderr)

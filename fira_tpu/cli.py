@@ -111,6 +111,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "are invariant to N (pinned by tests). A nonzero "
                         "--engine-slots is the fleet TOTAL and must divide "
                         "by N")
+    p.add_argument("--kv-paged", default=None, choices=["on", "off"],
+                   help="test: engine KV arena layout (docs/DECODE_ENGINE"
+                        ".md 'Paged KV arena'): 'on' (default) pages the "
+                        "per-slot self-attention caches into a fixed pool "
+                        "of KV blocks behind per-slot block tables — "
+                        "bit-exact per sample vs 'off' (the whole-"
+                        "sequence arena, kept as the equivalence "
+                        "comparator), while decoupling slot count from "
+                        "target length in HBM")
+    p.add_argument("--kv-block-size", type=int, default=None, metavar="B",
+                   help="test: paged-KV block size in cache positions; "
+                        "must divide every declared decode tar budget "
+                        "(validated at parse time, exit 2). 0/unset = "
+                        "auto (largest common divisor <= 16)")
+    p.add_argument("--kv-pool-blocks", type=int, default=None, metavar="P",
+                   help="test: paged-KV pool size in blocks (the fleet "
+                        "TOTAL, split across --engine-replicas like "
+                        "--engine-slots). Must keep every slot servable: "
+                        "per replica >= slots x ceil(tar/block) on the "
+                        "smallest decode tar and >= one largest-budget "
+                        "sample (validated at parse time, exit 2). "
+                        "0/unset = auto: full residency, scheduling "
+                        "identical to the unpaged arena")
+    p.add_argument("--decode-tar-buckets", action="store_true",
+                   help="test: let decode buckets keep their OWN tar "
+                        "lengths instead of pinning tar full — each "
+                        "sample packs into the smallest tar budget that "
+                        "fits its reference message, and the slot engine "
+                        "caps generation (and sizes its paged block "
+                        "reservation) at that budget. The longer-target "
+                        "door: raise the config tar_len and declare the "
+                        "common case as a bucket")
     p.add_argument("--beam-log-space", action="store_true",
                    help="log-space beam accumulation instead of the "
                         "reference-compat probability space")
@@ -230,6 +262,14 @@ def _resolve_cfg(args):
         overrides["engine_harvest_every"] = args.engine_harvest_every
     if args.engine_replicas is not None:
         overrides["engine_replicas"] = args.engine_replicas
+    if args.kv_paged is not None:
+        overrides["engine_paged_kv"] = args.kv_paged == "on"
+    if args.kv_block_size is not None:
+        overrides["kv_block_size"] = args.kv_block_size
+    if args.kv_pool_blocks is not None:
+        overrides["kv_pool_blocks"] = args.kv_pool_blocks
+    if args.decode_tar_buckets:
+        overrides["decode_tar_buckets"] = True
     if args.adjacency:
         overrides["adjacency_impl"] = args.adjacency
     if args.encoder_buffer:
@@ -349,9 +389,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         from fira_tpu.parallel.fleet import fleet_divisibility_errors
 
         errs += fleet_divisibility_errors(cfg)
+        # paged-KV knob admission (block size tiles every decode tar
+        # budget, pool floors per replica) — same exit-2 contract,
+        # decode/paging.paging_errors
+        from fira_tpu.decode.paging import paging_errors
+
+        errs += paging_errors(cfg)
     if errs:
         for e in errs:
-            print(f"mesh divisibility: {e}", file=sys.stderr)
+            print(f"parse-time validation: {e}", file=sys.stderr)
         return 2
 
     var_maps = _load_var_maps(args.data_dir)

@@ -170,6 +170,47 @@ class FiraConfig:
     # CPU length-mix bench (scripts/tpu_decode_bench.py engine_mixed row)
     # and the occupancy loss shows up honestly in slot_occupancy.
     engine_harvest_every: int = 4
+    # --- paged KV arena (decode/paging.py; docs/DECODE_ENGINE.md) ---
+    # True (default): the engine's per-slot self-attention K/V caches live
+    # in a FIXED POOL of KV blocks addressed through per-slot block tables
+    # (vLLM/PagedAttention under this stack's static shapes — gather/
+    # scatter by block id, fixed pool size, fixed table width). Slot
+    # residency decouples from sequence length: a slot holds only the
+    # blocks its decode bucket's tar budget reserves, so engine_slots can
+    # grow past what whole-sequence arenas allow at equal HBM and longer
+    # tar buckets become new bucket-table entries instead of a per-length
+    # arena blow-up. Per-sample BIT-exact (tokens AND probs) vs the
+    # unpaged arena at the base tar geometry in all four kv-cache x
+    # factored-topk modes (tests/test_paged_kv.py). False keeps the
+    # whole-sequence arena — the comparator the equivalence tests pin
+    # against. Only meaningful with beam_kv_cache (the non-cached engine
+    # path holds no K/V to page).
+    engine_paged_kv: bool = True
+    # KV block size (positions per block). Must divide EVERY declared
+    # decode tar length (cfg.tar_len plus, under decode_tar_buckets, each
+    # bucket's tar) so block tables tile each budget exactly — validated
+    # at parse time (decode/paging.paging_errors, CLI exit 2). 0 = auto:
+    # the largest common divisor of the declared tars <= min(16, tar/2).
+    kv_block_size: int = 0
+    # Total KV pool size in blocks (the fleet-TOTAL, split evenly across
+    # engine_replicas like engine_slots). Must keep every slot servable:
+    # per replica, pool >= slots x ceil(smallest decode tar / block) and
+    # >= ceil(largest decode tar / block) (one worst-case sample must
+    # always fit — the no-livelock floor). 0 = auto: full residency,
+    # slots x ceil(tar_len / block) per replica — byte-identical
+    # scheduling to the unpaged arena.
+    kv_pool_blocks: int = 0
+    # True: the decode bucket table keeps each declared bucket's OWN
+    # tar_len instead of pinning tar full, and the engine caps each
+    # slot's generation at its bucket's tar budget (its block
+    # reservation). Packing assigns by reference-message extent
+    # (smallest admissible tar bucket). This is the longer-target-
+    # geometry door: raise cfg.tar_len (say 64) and declare the common
+    # case (say tar 30) as a bucket — short messages reserve half the
+    # blocks, long ones get the full budget, ONE step program serves
+    # both. Off (default): tar pinned full on every decode bucket, the
+    # byte-identical historical behavior.
+    decode_tar_buckets: bool = False
     # Replicated-engine decode fleet (parallel/fleet.py; docs/MULTICHIP.md):
     # N SlotEngine replicas — one per device/data-mesh slice, each with its
     # own per-chip KV arena and compiled program set — pull packed chunks

@@ -346,13 +346,31 @@ def bucketed_assembly_tasks(split: ProcessedSplit, plan: Plan,
 # --------------------------------------------------------------------------
 
 def decode_table(cfg: FiraConfig) -> Tuple[BucketGeom, ...]:
-    """The decode-side bucket family: tar_len pinned to the FULL value on
-    every bucket (beam output length is model-decided and must not be
-    clipped), deduplicated, cost-sorted, full fallback last."""
+    """The decode-side bucket family, deduplicated, cost-sorted, full
+    fallback last.
+
+    Default (``cfg.decode_tar_buckets = False``): tar_len pinned to the
+    FULL value on every bucket — beam output length is model-decided and
+    must not be clipped.
+
+    ``decode_tar_buckets = True`` (the longer-target-geometry mode,
+    docs/DECODE_ENGINE.md "Paged KV arena"): each declared bucket KEEPS
+    its own tar_len, assignment goes by reference-message extent
+    (``use_msg=True`` — the caller's packing must match), and the slot
+    engine caps each sample's generation at its bucket's tar budget,
+    which is exactly the paged-KV block reservation the slot is seated
+    with. This turns a raised ``cfg.tar_len`` (say 64) plus a
+    common-case bucket (say tar 30) into two RESERVATION sizes against
+    one block pool and ONE step program — not a per-length program or
+    arena explosion. The batched-beam path ignores the cap (its scan is
+    always the full budget), so tar-bucketed decode is equivalence-
+    claimed only within the engine family (file-byte determinism across
+    schedules is pinned by tests/test_buckets.py)."""
     full = full_geom(cfg)
     geoms: List[BucketGeom] = []
     for g in bucket_table(cfg)[:-1]:
-        d = BucketGeom(g.ast_len, g.max_edges, cfg.tar_len)
+        d = (g if cfg.decode_tar_buckets
+             else BucketGeom(g.ast_len, g.max_edges, cfg.tar_len))
         if d != full and d not in geoms:
             geoms.append(d)
     geoms.sort(key=lambda g: geom_cost(cfg, g))

@@ -61,6 +61,25 @@ def _resolve_copy(tok, diff, sub_token, cfg: FiraConfig):
     )
 
 
+def step_valid_mask(flat, s, T: int):
+    """Cached-decode per-position validity, shared by the batched beam and
+    the slot engine's step program (decode/engine.py): real (nonzero)
+    prefix tokens, position 0 (<start>) always attended, causally
+    restricted to positions <= ``s``. ``s`` is a traced scalar (batch
+    beam: every row at the same depth) or a (B,) vector (engine: each row
+    at its slot's own depth) — identical per-row math either way, which is
+    one leg of the engine's bit-exactness argument. The same mask guards
+    the PAGED cache reads: positions a slot never wrote (stale pool
+    blocks included) are exactly -1e9-masked, and exp(-1e9 - m)
+    underflows to 0.0 in every stable softmax dtype, so unwritten block
+    contents multiply a hard zero — the reason freed blocks are unmapped,
+    never zeroed (tests/test_paged_kv.py pins it)."""
+    base = (flat != 0).at[:, 0].set(True)
+    s = jnp.asarray(s)
+    lim = s[:, None] if s.ndim else s
+    return base & (jnp.arange(T)[None, :] <= lim)
+
+
 def _init_beam(B: int, cfg: FiraConfig):
     """Initial (tokens, probs, finished) carry + the masked/pad value."""
     K, T = cfg.beam_size, cfg.tar_len
@@ -306,7 +325,7 @@ def beam_search_cached(model: FiraModel, params, batch: Dict[str, jnp.ndarray],
         flat = tokens.reshape(B * K, T)
         # same per-position validity rule as the full-prefix path's pad
         # mask, restricted causally to positions <= s
-        valid = (flat != 0).at[:, 0].set(True) & (jnp.arange(T)[None, :] <= s)
+        valid = step_valid_mask(flat, s, T)
         tok_in = jax.lax.dynamic_slice_in_dim(flat, s, 1, axis=1)  # (B*K, 1)
         if cfg.beam_factored_topk:
             gen, copy, gate, k_cache, v_cache = model.apply(

@@ -54,6 +54,29 @@ are BIT-EXACT equal to the batched beam's. The argument has three legs:
    tests/test_beam_early_exit.py already pins that stopping there equals
    running the full scan.
 
+Paged KV arena (``cfg.engine_paged_kv``, default on — decode/paging.py,
+docs/DECODE_ENGINE.md "Paged KV arena"): the per-slot self-attention
+caches live in a FIXED POOL of KV blocks — ``k_pool``/``v_pool``
+(L, P, beam, H, block, d_head) — addressed through a per-slot block
+table (S, W) instead of whole-sequence slot stripes. The step program
+appends into each live slot's current tail block and gathers its cache
+view by block id (model.Decoder.decode_step_paged); ``insert`` hands a
+fresh slot exactly the blocks its decode bucket's tar budget reserves;
+``harvest`` returns a settled slot's blocks to the host free list WHOLE
+— freed blocks are unmapped, never zeroed (beam.step_valid_mask already
+multiplies unwritten positions by an exact 0.0). Everything stays
+static-shape (fixed P, fixed W), so the program family above is
+unchanged and per-sample output is BIT-exact (tokens AND probs) vs the
+unpaged arena (tests/test_paged_kv.py). The point: slot residency
+decouples from sequence length — ``engine_slots`` grows past what
+whole-sequence arenas allow at equal HBM, and longer-tar decode buckets
+(``cfg.decode_tar_buckets``) become smaller/larger block RESERVATIONS
+against one pool instead of a per-length arena blow-up. The scheduler's
+admission becomes reservation-based when the pool is undersized: the
+head staged row waits until harvests return enough blocks (head-of-line,
+deterministic), and parse-time floors (decode/paging.paging_errors)
+guarantee it can always eventually be seated.
+
 Host scheduler (:meth:`SlotEngine.run`): drains the packer stream via the
 async feeder, prefills ahead (``cfg.engine_prefill_depth`` chunks),
 refills every freed slot, steps, harvests settled slots, and yields one
@@ -86,7 +109,9 @@ import numpy as np
 
 from fira_tpu.analysis.sanitizer import program_label
 from fira_tpu.config import FiraConfig
-from fira_tpu.decode.beam import _init_beam, _select, _select_factored
+from fira_tpu.decode import paging
+from fira_tpu.decode.beam import (_init_beam, _select, _select_factored,
+                                  step_valid_mask)
 from fira_tpu.model.model import FiraModel
 
 PREFILL_KIND = "engine_prefill"
@@ -107,6 +132,15 @@ class EngineStats:
     occupied_slot_steps: int = 0  # exact count of (slot, micro-step) pairs
                                   # that did real beam work (device-counted)
     commits: int = 0             # samples harvested
+    # paged-KV HBM accounting (decode/paging.py; 0/defaults when the
+    # engine runs the unpaged arena or no KV cache at all) — stamped by
+    # every step dispatch so a stats reset between timed windows
+    # (bench.py / tpu_decode_bench.py do exactly that) re-learns them
+    pool_blocks: int = 0         # fixed pool size P (paged only)
+    kv_block_size: int = 0       # positions per block (paged only)
+    kv_bytes_per_slot: int = 0   # committed K+V cache HBM per slot
+    block_steps: int = 0         # blocks in use, summed per step dispatch
+    peak_blocks: int = 0         # high-water mark of blocks in use
 
     @property
     def slot_occupancy(self) -> float:
@@ -117,6 +151,17 @@ class EngineStats:
     @property
     def steps_per_commit(self) -> float:
         return self.steps / self.commits if self.commits else 0.0
+
+    @property
+    def pool_utilization(self) -> float:
+        """Mean fraction of the KV pool mapped to live slots per step
+        dispatch. 1.0 for the unpaged arena (the whole-sequence stripes
+        are committed whether or not a slot is live — exactly the HBM
+        the paged pool stops paying); 0.0 with no KV cache at all."""
+        if self.pool_blocks and self.step_dispatches:
+            return self.block_steps / (self.step_dispatches
+                                       * self.pool_blocks)
+        return 1.0 if self.kv_bytes_per_slot else 0.0
 
     @property
     def dispatches(self) -> int:
@@ -134,6 +179,11 @@ class EngineStats:
             "dispatches": self.dispatches,
             "slot_occupancy": round(self.slot_occupancy, 4),
             "steps_per_commit": round(self.steps_per_commit, 3),
+            "pool_blocks": self.pool_blocks,
+            "kv_block_size": self.kv_block_size,
+            "kv_bytes_per_slot": self.kv_bytes_per_slot,
+            "peak_blocks": self.peak_blocks,
+            "pool_utilization": round(self.pool_utilization, 4),
         }
 
 
@@ -157,6 +207,10 @@ class _Staged:
     chunk: Dict                  # device pytree from the prefill program
     host: Dict                   # host batch (text-cooking fields + meta)
     rows: "collections.deque[Tuple[int, int]]"  # (row, split position)
+    limit: int                   # per-slot tar budget for this chunk's rows
+                                 # (the bucket's tar under decode_tar_buckets,
+                                 # else cfg.tar_len) — sets the paged block
+                                 # reservation AND the generation cap
 
 
 class SlotEngine:
@@ -175,7 +229,8 @@ class SlotEngine:
 
     def __init__(self, model: FiraModel, params, cfg: FiraConfig, *,
                  slots: Optional[int] = None, guard=None,
-                 device=None, tag: Optional[str] = None):
+                 device=None, tag: Optional[str] = None,
+                 pool_blocks: Optional[int] = None):
         self.model = model
         self.params = params
         self.cfg = cfg
@@ -185,6 +240,29 @@ class SlotEngine:
         self.guard = guard
         self.device = device
         self.tag = tag
+        # paged KV arena geometry (decode/paging.py). ``pool_blocks`` is
+        # THIS engine's pool (a fleet replica's per-chip share); None
+        # falls back to cfg.kv_pool_blocks, 0 to the full-residency auto
+        # size (slots x table width — scheduling identical to unpaged).
+        self._paged = bool(cfg.beam_kv_cache and cfg.engine_paged_kv)
+        self._block_size = self._table_width = self._pool_blocks = 0
+        self._kv_bytes_per_slot = 0
+        if self._paged:
+            self._block_size = paging.resolve_block_size(cfg)
+            if cfg.tar_len % self._block_size:
+                raise ValueError(
+                    f"kv_block_size {self._block_size} does not divide "
+                    f"tar_len {cfg.tar_len}; the block table must tile "
+                    f"the arena budget exactly (decode/paging.py)")
+            self._table_width = cfg.tar_len // self._block_size
+            self._pool_blocks = int(
+                pool_blocks if pool_blocks is not None
+                else cfg.kv_pool_blocks) or self.slots * self._table_width
+            if self._pool_blocks < self._table_width:
+                raise ValueError(
+                    f"kv_pool_blocks {self._pool_blocks} < table width "
+                    f"{self._table_width}: one full-tar sample must fit "
+                    f"an empty pool or admission livelocks")
         self.stats = EngineStats(slots=self.slots)
         self._state = None
         self._prefill = jax.jit(self._prefill_fn)
@@ -286,11 +364,66 @@ class SlotEngine:
         all_fin_before = jnp.all(finished, axis=1)   # (S,)
 
         out_caches = {}
-        if cfg.beam_kv_cache:
+        if cfg.beam_kv_cache and self._paged:
+            # same per-row validity rule as beam_search_cached, at the
+            # per-slot position vector (beam.step_valid_mask) — this mask
+            # is also what makes unwritten/stale POOL blocks read as an
+            # exact 0.0 contribution, so fresh slots need no zeroed cache
+            valid = step_valid_mask(flat, pos_bk, T)
+            tok_in = jnp.take_along_axis(flat, pos_bk[:, None], axis=1)
+            # idle and done slots must neither write nor permute: their
+            # table rows may still name blocks harvest already returned
+            # to the free list and insert re-granted to ANOTHER slot —
+            # the one aliasing hazard the whole-sequence arena never had.
+            # Masking their rows to the sentinel P turns every such
+            # gather into clamped (blended-away) garbage and every such
+            # scatter into a drop.
+            tab_step = jnp.where(active[:, None], state["block_tab"],
+                                 jnp.int32(self._pool_blocks))
+            if cfg.beam_factored_topk:
+                gen, copy, gate, k_pool, v_pool = model.apply(
+                    {"params": params}, mask_k, tok_in, pos_bk,
+                    state["k_pool"], state["v_pool"], tab_step,
+                    state["cross_k"], state["cross_v"], state["src_proj"],
+                    valid[:, None, None, :],
+                    method=FiraModel.dist_parts_step_paged,
+                )
+                new_tokens, new_probs, new_finished, src_beam = \
+                    _select_factored(
+                        gen[:, 0, :].reshape(S, K, -1),
+                        copy[:, 0, :].reshape(S, K, -1),
+                        gate[:, 0, :].reshape(S, K, 2),
+                        tokens, probs, finished, pos_c, slot_src, cfg, neg)
+            else:
+                fused, k_pool, v_pool = model.apply(
+                    {"params": params}, mask_k, tok_in, pos_bk,
+                    state["k_pool"], state["v_pool"], tab_step,
+                    state["cross_k"], state["cross_v"], state["src_proj"],
+                    valid[:, None, None, :],
+                    method=FiraModel.fused_probs_step_paged,
+                )
+                dist = fused[:, 0, :].reshape(S, K, -1)
+                new_tokens, new_probs, new_finished, src_beam = _select(
+                    dist, tokens, probs, finished, pos_c, slot_src, cfg, neg)
+            # permute cached histories to follow their beams — the paged
+            # twin of the unpaged gather below, moving block CONTENTS
+            # within each active slot's own block set (table entries stay
+            # put: a slot's grant is host-owned from insert to harvest).
+            # Scatter targets are disjoint across slots because grants
+            # never overlap; sentinel rows (idle/done, see tab_step) drop.
+            idx = src_beam[None, :, None, :, None, None, None]
+
+            def permute_pool(pool):
+                blocks = pool[:, tab_step]       # (L, S, W, K, H, BS, dh)
+                blocks = jnp.take_along_axis(blocks, idx, axis=3)
+                return pool.at[:, tab_step].set(blocks, mode="drop")
+
+            out_caches["k_pool"] = permute_pool(k_pool)
+            out_caches["v_pool"] = permute_pool(v_pool)
+        elif cfg.beam_kv_cache:
             # same per-row validity rule as beam_search_cached, at the
             # per-slot position vector
-            valid = (flat != 0).at[:, 0].set(True) & (
-                jnp.arange(T)[None, :] <= pos_bk[:, None])
+            valid = step_valid_mask(flat, pos_bk, T)
             tok_in = jnp.take_along_axis(flat, pos_bk[:, None], axis=1)
             if cfg.beam_factored_topk:
                 gen, copy, gate, k_cache, v_cache = model.apply(
@@ -366,17 +499,33 @@ class SlotEngine:
         # the early-exit predicate, per slot: stopping is exact once the
         # settling step has re-sorted an all-finished beam set
         # (decode/beam._run_steps; tests/test_beam_early_exit.py), or when
-        # the position budget is exhausted
-        done = state["done"] | (active & ((new_pos >= T - 1)
+        # the position budget is exhausted — the SLOT's own budget: its
+        # decode bucket's tar under cfg.decode_tar_buckets (the paged
+        # block reservation it was seated with), cfg.tar_len otherwise
+        done = state["done"] | (active & ((new_pos >= state["limit"] - 1)
                                           | (all_fin_before & all_fin_after)))
         return (dict(state, tokens=tokens, probs=probs, finished=finished,
                      pos=new_pos, done=done, **out_caches),
                 jnp.sum(active.astype(jnp.int32)))
 
-    def _insert_fn(self, state, chunk, slot_ids):
+    def _insert_fn(self, state, chunk, slot_ids, limits, block_rows):
         """Scatter chunk rows into slots. ``slot_ids``: (C,) int32, row j
         goes to slot ``slot_ids[j]``; the out-of-range sentinel S marks
-        rows NOT consumed by this call (their scatter drops)."""
+        rows NOT consumed by this call (their scatter drops). ``limits``:
+        (C,) int32 per-row tar budget. ``block_rows`` (paged arena only,
+        else None): (C, W) int32 block grants, sentinel-P-padded past the
+        row's reservation.
+
+        INVARIANT — no cache zeroing, in EITHER arena. A fresh slot's
+        unwritten cache positions are exactly -1e9-masked by the step's
+        validity rule (beam.step_valid_mask) and exp(-1e9 - m) underflows
+        to 0.0 in the stable softmax dtype, so stale values multiply a
+        hard zero: the whole-sequence arena's old two full-arena zero
+        scatters per refill bought nothing, and the paged arena has
+        nothing to zero at all — freed blocks are simply UNMAPPED.
+        tests/test_paged_kv.py pins this by object identity on the
+        k/v buffers through an eager insert AND by bit-exact reuse of a
+        dirty arena, so the zeroing cannot silently reappear."""
         cfg = self.cfg
         K = cfg.beam_size
         C = slot_ids.shape[0]
@@ -398,14 +547,18 @@ class SlotEngine:
         new["pos"] = state["pos"].at[sid].set(0, mode="drop")
         new["live"] = state["live"].at[sid].set(True, mode="drop")
         new["done"] = state["done"].at[sid].set(False, mode="drop")
+        new["limit"] = state["limit"].at[sid].set(
+            limits.astype(jnp.int32), mode="drop")
         if cfg.beam_kv_cache:
             for f in ("cross_k", "cross_v"):
                 new[f] = state[f].at[:, sid_bk].set(chunk[f], mode="drop")
             new["src_proj"] = state["src_proj"].at[sid_bk].set(
                 chunk["src_proj"], mode="drop")
-            # fresh slots start from the batched beam's zero cache
-            new["k_cache"] = state["k_cache"].at[:, sid_bk].set(0, mode="drop")
-            new["v_cache"] = state["v_cache"].at[:, sid_bk].set(0, mode="drop")
+            if self._paged:
+                # hand the seated rows their block grants; k_pool/v_pool
+                # are untouched (see INVARIANT above)
+                new["block_tab"] = state["block_tab"].at[sid].set(
+                    block_rows.astype(jnp.int32), mode="drop")
         else:
             new["states"] = state["states"].at[sid_bk].set(
                 chunk["states"], mode="drop")
@@ -435,6 +588,9 @@ class SlotEngine:
             "sub_token": np.zeros((S,) + chunk["sub_token"].shape[1:],
                                   chunk["sub_token"].dtype),
             "src_mask": np.zeros((S,) + chunk["src_mask"].shape[1:], bool),
+            # per-slot tar budget: full until an insert seats a
+            # shorter-bucket sample (cfg.decode_tar_buckets)
+            "limit": np.full((S,), T, np.int32),
         }
         if cfg.beam_kv_cache:
             ck = chunk["cross_k"]
@@ -443,8 +599,19 @@ class SlotEngine:
             sp = chunk["src_proj"]
             z["src_proj"] = np.zeros((S * K,) + sp.shape[1:], sp.dtype)
             cd = chunk["cache_seed"].dtype
-            z["k_cache"] = np.zeros((L, S * K, H, T, d_head), cd)
-            z["v_cache"] = np.zeros((L, S * K, H, T, d_head), cd)
+            if self._paged:
+                P, BS, W = (self._pool_blocks, self._block_size,
+                            self._table_width)
+                z["k_pool"] = np.zeros((L, P, K, H, BS, d_head), cd)
+                z["v_pool"] = np.zeros((L, P, K, H, BS, d_head), cd)
+                z["block_tab"] = np.full((S, W), P, np.int32)  # all unmapped
+            else:
+                z["k_cache"] = np.zeros((L, S * K, H, T, d_head), cd)
+                z["v_cache"] = np.zeros((L, S * K, H, T, d_head), cd)
+            self._kv_bytes_per_slot = paging.kv_bytes_per_slot(
+                cfg, paged=self._paged, block_size=self._block_size,
+                pool_blocks=self._pool_blocks, slots=S,
+                itemsize=np.dtype(cd).itemsize)
         else:
             st = chunk["states"]
             z["states"] = np.zeros((S * K,) + st.shape[1:], st.dtype)
@@ -479,6 +646,11 @@ class SlotEngine:
         self._staged_rows = 0
         self._free: List[int] = list(range(self.slots))
         self._busy: Dict[int, Tuple[int, Dict, int]] = {}
+        # paged-KV block allocator: the free list and the per-slot grant
+        # map reset with the scheduler; the POOL CONTENTS do not — stale
+        # block values are exactly masked, never read (beam.step_valid_mask)
+        self._free_blocks: List[int] = list(range(self._pool_blocks))
+        self._slot_blocks: Dict[int, List[int]] = {}
 
     def wants_input(self) -> bool:
         """Prefill-ahead policy: keep ``engine_prefill_depth`` chunks
@@ -515,25 +687,52 @@ class SlotEngine:
                       else index * C + r)
             rows.append((r, pos_id))
         if rows:
-            self._staged.append(_Staged(chunk=chunk, host=host, rows=rows))
+            # the chunk's tar budget: its bucket geometry is visible in
+            # the packed msg width (make_batch slices msg to the bucket's
+            # tar) — under decode_tar_buckets that budget caps generation
+            # and sizes the paged block reservation; otherwise every slot
+            # gets the full arena budget, the historical behavior
+            limit = (int(host["msg"].shape[1]) if self.cfg.decode_tar_buckets
+                     else self.cfg.tar_len)
+            self._staged.append(_Staged(chunk=chunk, host=host, rows=rows,
+                                        limit=limit))
             self._staged_rows += len(rows)
 
     def refill(self, refill_order: str = "fifo") -> None:
         """Insert staged rows into every free slot (one insert dispatch
-        per staged chunk touched)."""
+        per staged chunk touched). Paged arena: each seated row is granted
+        its reservation — ceil(limit / block) blocks — from the free
+        list; when the pool cannot cover the HEAD row's reservation the
+        refill stops there and waits for harvests to return blocks
+        (head-of-line, so admission order — hence output bytes — stays a
+        pure function of the stream, pool size included)."""
         while self._free and self._staged:
             entry = self._staged[0]
+            need = (paging.blocks_per_seq(entry.limit, self._block_size)
+                    if self._paged else 0)
+            if self._paged and len(self._free_blocks) < need:
+                break  # head-of-line: blocks return at the next harvest
             C = entry.host["valid"].shape[0]
             slot_ids = np.full((C,), self.slots, dtype=np.int32)  # S = drop
+            limits = np.full((C,), entry.limit, dtype=np.int32)
+            block_rows = (np.full((C, self._table_width), self._pool_blocks,
+                                  dtype=np.int32)  # P = unmapped sentinel
+                          if self._paged else None)
             n_ins = 0
-            while self._free and entry.rows:
+            while self._free and entry.rows and (
+                    not self._paged or len(self._free_blocks) >= need):
                 r, pos_id = entry.rows.popleft()
                 slot = (self._free.pop(0) if refill_order == "fifo"
                         else self._free.pop())
                 slot_ids[r] = slot
+                if self._paged:
+                    grant = [self._free_blocks.pop(0) for _ in range(need)]
+                    block_rows[r, :need] = grant
+                    self._slot_blocks[slot] = grant
                 self._busy[slot] = (pos_id, entry.host, r)
                 n_ins += 1
-            self._state = self._insert(self._state, entry.chunk, slot_ids)
+            self._state = self._insert(self._state, entry.chunk, slot_ids,
+                                       limits, block_rows)
             self._guard_step(self.label(INSERT_LABEL))
             self.stats.refills += 1
             self.stats.slots_refilled += n_ins
@@ -547,8 +746,18 @@ class SlotEngine:
         overlaps across chips)."""
         self._state, self._pending_occ = self._step(self.params, self._state)
         self._guard_step(self.label(STEP_LABEL))
-        self.stats.step_dispatches += 1
-        self.stats.steps += max(1, int(self.cfg.engine_harvest_every))
+        st = self.stats
+        st.step_dispatches += 1
+        st.steps += max(1, int(self.cfg.engine_harvest_every))
+        # pool accounting, re-stamped every dispatch so the bench's stats
+        # resets between timed windows keep the HBM fields populated
+        st.pool_blocks = self._pool_blocks
+        st.kv_block_size = self._block_size
+        st.kv_bytes_per_slot = self._kv_bytes_per_slot
+        if self._paged:
+            used = self._pool_blocks - len(self._free_blocks)
+            st.block_steps += used
+            st.peak_blocks = max(st.peak_blocks, used)
 
     def harvest(self) -> Iterator[EngineItem]:
         """Read back the dispatched step's done mask and yield every newly
@@ -566,6 +775,10 @@ class SlotEngine:
             for s in newly:
                 pos_id, host, r = self._busy.pop(s)
                 self._free.append(s)
+                # the slot's block grant returns WHOLE — contents stay as
+                # the slot left them (unmapped, not zeroed; the next
+                # grantee's validity mask makes them an exact 0.0)
+                self._free_blocks.extend(self._slot_blocks.pop(s, ()))
                 stats.commits += 1
                 yield EngineItem(position=pos_id, host=host, row=r,
                                  tokens=toks[s], probs=probs[s])

@@ -58,9 +58,13 @@ def _decode_tasks(data, cfg: FiraConfig):
     the batched beam would dispatch."""
     if cfg.buckets:
         table = buckets_lib.decode_table(cfg)
+        # tar-bucketed decode assigns by reference-message extent (the
+        # bucket's tar is a generation budget, so a sample must FIT its
+        # bucket); the tar-pinned default ignores msg, as before
         plan = buckets_lib.packed_plan(data, cfg,
                                        batch_size=cfg.test_batch_size,
-                                       table=table, use_msg=False)
+                                       table=table,
+                                       use_msg=cfg.decode_tar_buckets)
         tasks = buckets_lib.bucketed_assembly_tasks(
             data, plan, cfg, batch_size=cfg.test_batch_size)
         return tasks, table

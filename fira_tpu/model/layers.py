@@ -316,6 +316,42 @@ class Attention(nn.Module):
                            causal=causal)
 
 
+def gather_block_kv(pool_l: jnp.ndarray, block_tab: jnp.ndarray
+                    ) -> jnp.ndarray:
+    """Assemble one layer's per-row K (or V) cache view from a paged block
+    pool (decode/engine.py; docs/DECODE_ENGINE.md "Paged KV arena").
+
+    pool_l: one layer's pool slice, (P, K, H, BS, d_head): P fixed pool
+    blocks, each holding BS cache positions for all K beams of the owning
+    slot. block_tab: (S, W) int32 — slot s's position range
+    [w*BS, (w+1)*BS) lives in block ``block_tab[s, w]``; the sentinel id P
+    marks unmapped entries (gather CLAMPS them to a garbage block whose
+    values are exactly zeroed by the validity mask's -1e9 —
+    beam.step_valid_mask).
+
+    Returns (S*K, H, W*BS, d_head): row-major (slot, beam) rows in the
+    exact layout ``Attention.attend`` consumes, bit-identical for every
+    written position to the whole-sequence cache it replaces."""
+    P, K, H, BS, d_head = pool_l.shape
+    S, W = block_tab.shape
+    blocks = pool_l[block_tab]                      # (S, W, K, H, BS, dh)
+    blocks = blocks.transpose(0, 2, 3, 1, 4, 5)     # (S, K, H, W, BS, dh)
+    return blocks.reshape(S * K, H, W * BS, d_head)
+
+
+def append_block_kv(pool: jnp.ndarray, layer: int, blk: jnp.ndarray,
+                    krow: jnp.ndarray, off: jnp.ndarray, new: jnp.ndarray
+                    ) -> jnp.ndarray:
+    """Write one decode position into the paged pool: row r's projected
+    K (or V) at this step lands at ``pool[layer, blk[r], krow[r], :,
+    off[r], :]``. pool: (L, P, K, H, BS, d_head); blk/krow/off: (B,) int32
+    per-row block id / beam lane / in-block offset; new: (B, H, d_head).
+    ``mode="drop"`` makes sentinel block ids (idle/done rows the engine
+    masked out) write NOWHERE — a freed block can never be scribbled on by
+    the slot that used to own it."""
+    return pool.at[layer, blk, krow, :, off, :].set(new, mode="drop")
+
+
 class FeedForward(nn.Module):
     """Post-LN 4x ReLU FFN (gnn_transformer.py:163-174)."""
 

@@ -27,6 +27,8 @@ from flax import linen as nn
 from fira_tpu.config import FiraConfig
 from fira_tpu.model.layers import (
     stable_dtype,
+    append_block_kv,
+    gather_block_kv,
     Attention,
     Combination,
     FeedForward,
@@ -338,6 +340,53 @@ class Decoder(nn.Module):
             x = getattr(self, f"ffn_{i}")(x, deterministic=True)
         return x, k_cache, v_cache
 
+    def decode_step_paged(self, tok, pos_idx, k_pool, v_pool, block_tab,
+                          cross_k, cross_v, sou_mask, self_mask):
+        """:meth:`decode_step_multi` with the self-attention cache behind
+        BLOCK-TABLE INDIRECTION (the slot engine's paged KV arena,
+        decode/engine.py): instead of each row owning a whole-sequence
+        (tar_len) cache stripe, the cache lives in a fixed pool of KV
+        blocks — k_pool/v_pool: (L, P, K, H, block, d_head) — and
+        ``block_tab`` (S, W) maps slot s's position range
+        [w*block, (w+1)*block) to a pool block (sentinel id P = unmapped:
+        reads clamp to garbage the validity mask zeroes exactly, writes
+        drop). Per written position the gathered cache view is
+        bit-identical to the whole-sequence cache, so the attention math
+        — and therefore the beam trajectory — is unchanged
+        (tests/test_paged_kv.py pins tokens AND probs bitwise).
+
+        tok: (S*K, 1) token ids; pos_idx: (S*K,) per-row positions (rows
+        of one slot share theirs); W*block must equal the attended cache
+        width ``self_mask.shape[-1]``."""
+        _L, _P, K, _H, BS, _dh = k_pool.shape
+        B = tok.shape[0]
+        S, W = block_tab.shape
+        if W * BS != self_mask.shape[-1] or B != S * K:
+            raise ValueError(
+                f"paged cache geometry mismatch: table {W} x block {BS} "
+                f"must tile the {self_mask.shape[-1]}-position budget and "
+                f"pool beam lanes {K} x {S} slots must equal the {B} rows")
+        pos = pos_idx.astype(jnp.int32)
+        slot = jnp.arange(B, dtype=jnp.int32) // K
+        krow = jnp.arange(B, dtype=jnp.int32) % K
+        blk = block_tab[slot, pos // BS]             # (B,) current tail block
+        off = pos % BS
+        x = self.embed(tok) + self._pos_table()[pos][:, None, :]
+        for i in range(self.cfg.num_layers):
+            sa = getattr(self, f"self_attn_{i}")
+            k_new, v_new = sa.project_kv(x, x)       # (B, H, 1, d_head)
+            k_pool = append_block_kv(k_pool, i, blk, krow, off,
+                                     k_new[:, :, 0, :])
+            v_pool = append_block_kv(v_pool, i, blk, krow, off,
+                                     v_new[:, :, 0, :])
+            x = sa.attend(x, gather_block_kv(k_pool[i], block_tab),
+                          gather_block_kv(v_pool[i], block_tab),
+                          self_mask, deterministic=True)
+            x = getattr(self, f"cross_attn_{i}").attend(
+                x, cross_k[i], cross_v[i], sou_mask, deterministic=True)
+            x = getattr(self, f"ffn_{i}")(x, deterministic=True)
+        return x, k_pool, v_pool
+
 
 class _ScoreHead(nn.Module):
     """Parameter container matching TorchDense(1, name="score") exactly
@@ -582,6 +631,34 @@ class FiraModel(nn.Module):
         )
         gen, copy, gate = self._step_heads(mask, src_proj, tar_emb)
         return gen, copy, gate, k_cache, v_cache
+
+    def dist_parts_step_paged(self, mask, tok, pos_idx, k_pool, v_pool,
+                              block_tab, cross_k, cross_v, src_proj,
+                              self_mask):
+        """Paged-arena twin of :meth:`dist_parts_step_multi`: the self-
+        attention cache is read and written through block-table
+        indirection (Decoder.decode_step_paged) instead of whole-sequence
+        stripes; heads are the shared :meth:`_step_heads`, so per row the
+        distribution factors are bit-identical to the unpaged step."""
+        tar_emb, k_pool, v_pool = self.decoder.decode_step_paged(
+            tok, pos_idx, k_pool, v_pool, block_tab, cross_k, cross_v,
+            mask, self_mask,
+        )
+        gen, copy, gate = self._step_heads(mask, src_proj, tar_emb)
+        return gen, copy, gate, k_pool, v_pool
+
+    def fused_probs_step_paged(self, mask, tok, pos_idx, k_pool, v_pool,
+                               block_tab, cross_k, cross_v, src_proj,
+                               self_mask):
+        """Paged-arena twin of :meth:`fused_probs_step_multi` — the
+        engine's non-factored step head over the block pool."""
+        gen, copy, gate, k_pool, v_pool = self.dist_parts_step_paged(
+            mask, tok, pos_idx, k_pool, v_pool, block_tab, cross_k,
+            cross_v, src_proj, self_mask)
+        fused = jnp.concatenate(
+            [gate[:, :, 0:1] * gen, gate[:, :, 1:2] * copy], axis=-1
+        )
+        return fused, k_pool, v_pool
 
     def fused_probs_step_multi(self, mask, tok, pos_idx, k_cache, v_cache,
                                cross_k, cross_v, src_proj, self_mask):

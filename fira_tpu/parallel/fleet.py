@@ -54,7 +54,11 @@ def fleet_divisibility_errors(cfg: FiraConfig) -> List[str]:
     """Parse-time fleet admission check (the decode twin of
     parallel.mesh.divisibility_errors): a nonzero ``engine_slots`` is the
     fleet-TOTAL arena, split evenly across replicas — reject a non-divisor
-    up front instead of failing in the arena allocation mid-run."""
+    up front instead of failing in the arena allocation mid-run. The
+    paged-KV pool splits the same way (``kv_pool_blocks`` is the fleet
+    total), but its split and floors are owned by
+    decode/paging.paging_errors, which the CLI runs right after this
+    check — one message per violation, not two."""
     reps = max(1, int(cfg.engine_replicas))
     if reps > 1 and cfg.engine_slots and cfg.engine_slots % reps:
         return [f"engine_slots {cfg.engine_slots} is not divisible by "
@@ -76,7 +80,24 @@ class FleetStats:
     def summary(self) -> Dict:
         tot = lambda f: sum(getattr(r, f) for r in self.replicas)  # noqa: E731
         steps_x_slots = sum(r.steps * r.slots for r in self.replicas)
+        # fleet-wide paged-KV pool accounting: pools are per-chip, so
+        # blocks total across replicas and utilization weights each
+        # replica's pool by its own dispatch count
+        pool_capacity = sum(r.step_dispatches * r.pool_blocks
+                            for r in self.replicas)
+        if pool_capacity:
+            pool_util = round(tot("block_steps") / pool_capacity, 4)
+        else:
+            pool_util = (1.0 if any(r.kv_bytes_per_slot
+                                    for r in self.replicas) else 0.0)
         return {
+            "pool_blocks": tot("pool_blocks"),
+            "kv_block_size": max((r.kv_block_size for r in self.replicas),
+                                 default=0),
+            "kv_bytes_per_slot": max((r.kv_bytes_per_slot
+                                      for r in self.replicas), default=0),
+            "peak_blocks": tot("peak_blocks"),
+            "pool_utilization": pool_util,
             "replicas": len(self.replicas),
             "slots": tot("slots"),
             "prefills": tot("prefills"),
@@ -120,6 +141,16 @@ class EngineFleet:
                 f"{replicas} (the fleet splits the total slot arena evenly "
                 f"across replicas)")
         per_replica = total // replicas if total else None
+        # kv_pool_blocks is the fleet TOTAL like engine_slots: each
+        # replica owns a per-chip pool of total/replicas blocks (0 keeps
+        # each engine's own full-residency auto size)
+        pool_total = int(cfg.kv_pool_blocks)
+        if pool_total and pool_total % replicas:
+            raise ValueError(
+                f"kv_pool_blocks {pool_total} is not divisible by "
+                f"engine_replicas {replicas} (the fleet splits the total "
+                f"KV block pool evenly across replicas)")
+        per_replica_pool = pool_total // replicas if pool_total else None
         if devices is None:
             devs = jax.devices()
             devices = [devs[i % len(devs)] for i in range(replicas)]
@@ -130,7 +161,7 @@ class EngineFleet:
         self.engines = [
             SlotEngine(model, jax.device_put(params, devices[i]), cfg,
                        slots=per_replica, guard=guard, device=devices[i],
-                       tag=f"r{i}")
+                       tag=f"r{i}", pool_blocks=per_replica_pool)
             for i in range(replicas)
         ]
 

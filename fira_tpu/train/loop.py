@@ -82,9 +82,16 @@ def _eval_tasks(data, cfg: FiraConfig, plan=None):
     once instead of re-deriving extents/assignment at every dev gate."""
     if cfg.buckets:
         if plan is None:
+            # tar stays PINNED FULL here even under cfg.decode_tar_buckets
+            # (an engine-only generation knob): the teacher-forced gating
+            # metric scores every tar position, and use_msg=False packing
+            # would otherwise seat long-message samples in short-tar
+            # buckets and trip make_batch's admissibility backstop mid-run
+            dev_cfg = cfg.replace(decode_tar_buckets=False)
             plan = buckets_lib.packed_plan(data, cfg,
                                            batch_size=cfg.test_batch_size,
-                                           table=buckets_lib.decode_table(cfg),
+                                           table=buckets_lib.decode_table(
+                                               dev_cfg),
                                            use_msg=False)
         return buckets_lib.bucketed_assembly_tasks(
             data, plan, cfg, batch_size=cfg.test_batch_size)
@@ -323,10 +330,13 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
             buckets_lib.sample_extents(train_split, cfg), bucket_table)
         warm_per_step = group_size == 1 or fused > 1
         # dev packs with the decode table (tar pinned full — the gating
-        # metric scores every tar position, see _eval_tasks); the dev plan
-        # is shuffle=False and never changes, so compute it ONCE here
-        # instead of re-deriving extents/assignment at every dev gate
-        dev_geoms = buckets_lib.decode_table(cfg)
+        # metric scores every tar position, see _eval_tasks, so the
+        # engine-only cfg.decode_tar_buckets knob is forced off here);
+        # the dev plan is shuffle=False and never changes, so compute it
+        # ONCE here instead of re-deriving extents/assignment at every
+        # dev gate
+        dev_geoms = buckets_lib.decode_table(
+            cfg.replace(decode_tar_buckets=False))
         dev_plan = buckets_lib.packed_plan(
             dataset.splits["valid"], cfg, batch_size=cfg.test_batch_size,
             table=dev_geoms, use_msg=False)

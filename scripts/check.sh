@@ -9,18 +9,19 @@ cd "$(dirname "$0")/.."
 
 echo "== firacheck: static JAX-hazard scan =="
 # fira_tpu/data/feeder.py, fira_tpu/data/buckets.py,
-# fira_tpu/data/grouping.py, fira_tpu/decode/engine.py and
-# fira_tpu/parallel/fleet.py are named explicitly (as well as being
-# inside the fira_tpu tree, which the CLI dedupes): the async input
-# pipeline, the bucket packer, the grouped dispatch scheduler, the
-# slot-refill decode engine and the replicated decode fleet are
+# fira_tpu/data/grouping.py, fira_tpu/decode/engine.py,
+# fira_tpu/decode/paging.py and fira_tpu/parallel/fleet.py are named
+# explicitly (as well as being inside the fira_tpu tree, which the CLI
+# dedupes): the async input pipeline, the bucket packer, the grouped
+# dispatch scheduler, the slot-refill decode engine, the paged-KV
+# arena geometry/validation and the replicated decode fleet are
 # designated driver modules (astutil._DRIVER_FILES) whose
 # threaded/packing/refill loops MUST stay in the self-scan even if the
 # directory arguments ever change.
 JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu fira_tpu/data/feeder.py fira_tpu/data/buckets.py \
     fira_tpu/data/grouping.py fira_tpu/decode/engine.py \
-    fira_tpu/parallel/fleet.py tests scripts \
+    fira_tpu/decode/paging.py fira_tpu/parallel/fleet.py tests scripts \
     || exit $?
 
 echo "== multichip smoke: 2 virtual CPU devices (docs/MULTICHIP.md) =="

@@ -168,31 +168,45 @@ def drive_engine(eng):
             pass
 
 
-def engine_row(tag, ps):
-    eng = engine_lib.SlotEngine(model_eng, ps, cfg_eng)
+def engine_row(tag, ps, *, model=None, cfg=None, driver=None, slots=None,
+               pool_blocks=None):
+    """One timed engine drain. ``driver``/``cfg``/``model`` default to the
+    flat mixed stream above; the paged rows pass their own bucketed
+    stream. kv_* / pool_* fields are the machine-recorded HBM accounting
+    (decode/paging.py) every paged-vs-unpaged claim rides on."""
+    cfg = cfg or cfg_eng
+    eng = engine_lib.SlotEngine(model or model_eng, ps, cfg, slots=slots,
+                                pool_blocks=pool_blocks)
+    drive = driver or drive_engine
     t0 = time.perf_counter()
-    drive_engine(eng)                      # compiles prefill/step/insert
+    drive(eng)                             # compiles prefill/step/insert
     compile_s = time.perf_counter() - t0
     times = []
     for _ in range(2):
         eng.stats = engine_lib.EngineStats(slots=eng.slots)
         t0 = time.perf_counter()
-        drive_engine(eng)
+        drive(eng)
         times.append(time.perf_counter() - t0)
     dt = min(times)
     st = eng.stats.summary()
     cps = st["commits"] / dt
     print(json.dumps({
         "tag": tag, "commits_per_sec": round(cps, 1),
-        "batch": BATCH, "slots": st["slots"], "beam": cfg_eng.beam_size,
-        "tar_len": cfg_eng.tar_len, "n_commits": st["commits"],
+        "batch": BATCH, "slots": st["slots"], "beam": cfg.beam_size,
+        "tar_len": cfg.tar_len, "n_commits": st["commits"],
         "slot_occupancy": st["slot_occupancy"],
         "steps_run": st["steps_run"], "refills": st["refills"],
         "steps_per_commit": st["steps_per_commit"],
         "dispatches": st["dispatches"],
+        "paged": eng._paged,
+        "pool_blocks": st["pool_blocks"],
+        "kv_block_size": st["kv_block_size"],
+        "kv_bytes_per_slot": st["kv_bytes_per_slot"],
+        "peak_blocks": st["peak_blocks"],
+        "pool_utilization": st["pool_utilization"],
         "compile_s": round(compile_s, 1),
     }), flush=True)
-    return cps
+    return cps, st
 
 
 def batch_early_exit_row(tag, ps):
@@ -232,10 +246,95 @@ def batch_early_exit_row(tag, ps):
 
 
 v_batch_mixed = batch_early_exit_row("kv_early_exit_mixed", params_mixed)
-v_engine_mixed = engine_row("engine_mixed", params_mixed)
+v_engine_mixed, _ = engine_row("engine_mixed", params_mixed)
 engine_row("engine", params)
 engine_row("engine_saturated", params_eos)
 print(json.dumps({
     "tag": "speedup_engine_over_early_exit_mixed",
     "value": round(v_engine_mixed / v_batch_mixed, 2),
 }), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Paged KV arena rows (cfg.engine_paged_kv; decode/paging.py +
+# docs/DECODE_ENGINE.md "Paged KV arena"): the longer-target-geometry
+# door. Raise tar_len to DECODE_PAGED_TAR (the PR-description budget the
+# 30-position arena could never host) and declare the common case —
+# DECODE_PAGED_TAR_SHORT — as a decode tar bucket: short messages reserve
+# ceil(short/block) pool blocks, long ones the full budget, ONE step
+# program serves both. Three rows make the HBM claim machine-recorded:
+#
+#   unpaged_tar<T>            whole-sequence arena at the long budget —
+#                             every slot commits the full T-position
+#                             stripe (kv_bytes_per_slot is the price);
+#   paged_tar<T>              same slots, full-residency pool — equal
+#                             bytes, pool_utilization shows the share
+#                             mixed reservations actually map;
+#   paged_tar<T>_2xslots      TWICE the slots against the SAME pool bytes
+#                             as the unpaged row (kv_bytes_per_slot
+#                             halves) — the equal-memory slot-count gain,
+#                             servable because the short bucket dominates
+#                             real streams.
+#
+# DECODE_PAGED=0 skips the leg (it pays its own model init + compiles).
+# --------------------------------------------------------------------------
+if os.environ.get("DECODE_PAGED", "1") == "1":
+    from fira_tpu.data import buckets as buckets_lib
+    from fira_tpu.decode import paging
+
+    PAGED_TAR = int(os.environ.get("DECODE_PAGED_TAR", "64"))
+    PAGED_TAR_SHORT = int(os.environ.get("DECODE_PAGED_TAR_SHORT",
+                                         str(PAGED_TAR // 2)))
+    cfg_p0 = get_config(CONFIG).replace(
+        batch_size=BATCH, test_batch_size=BATCH, compute_dtype=DTYPE,
+        tar_len=PAGED_TAR, decode_tar_buckets=True,
+        beam_kv_cache=True, beam_factored_topk=False)
+    cfg_p0 = cfg_p0.replace(buckets=(
+        (cfg_p0.ast_change_len, cfg_p0.max_edges, PAGED_TAR_SHORT),))
+    cfg_p, split_p, _ = make_memory_split(cfg_p0, max(256, BATCH), seed=0,
+                                          pad_vocab_to=pad_v,
+                                          pad_ast_vocab_to=71 if pad_v else 0)
+    model_p = FiraModel(cfg_p, dtype=jnp.dtype(DTYPE))
+    host_p = make_batch(split_p, np.arange(min(BATCH, len(split_p))), cfg_p,
+                        batch_size=BATCH)
+    params_p = eos_biased_params(init_state(model_p, cfg_p, host_p).params,
+                                 delta=ENGINE_MIX_DELTA)
+
+    table_p = buckets_lib.decode_table(cfg_p)
+    plan_p = buckets_lib.packed_plan(split_p, cfg_p, batch_size=BATCH,
+                                     table=table_p, use_msg=True)
+
+    def drive_paged(eng):
+        tasks = buckets_lib.bucketed_assembly_tasks(split_p, plan_p, cfg_p,
+                                                    batch_size=BATCH)
+        with Feeder(tasks, num_workers=2, depth=2) as feed:
+            for _ in eng.run(feed):
+                pass
+
+    bs_p = paging.resolve_block_size(cfg_p)
+    w_long = paging.blocks_per_seq(PAGED_TAR, bs_p)
+    n_short = sum(len(ix) for ix, g in plan_p if g.tar_len == PAGED_TAR_SHORT)
+    print(json.dumps({
+        "tag": "paged_stream", "tar": PAGED_TAR,
+        "tar_short": PAGED_TAR_SHORT, "block_size": bs_p,
+        "n_commits": len(split_p), "n_short_bucket": n_short,
+        "n_batches": len(plan_p),
+    }), flush=True)
+    _, st_unpaged = engine_row(
+        f"unpaged_tar{PAGED_TAR}", params_p,
+        model=model_p, cfg=cfg_p.replace(engine_paged_kv=False),
+        driver=drive_paged)
+    engine_row(f"paged_tar{PAGED_TAR}", params_p, model=model_p, cfg=cfg_p,
+               driver=drive_paged)
+    # SAME pool bytes as the unpaged row's arena (BATCH x W_long blocks),
+    # twice the slots: kv_bytes_per_slot halves at equal total HBM
+    _, st_2x = engine_row(
+        f"paged_tar{PAGED_TAR}_2xslots", params_p, model=model_p, cfg=cfg_p,
+        driver=drive_paged, slots=2 * BATCH, pool_blocks=BATCH * w_long)
+    print(json.dumps({
+        "tag": "paged_equal_hbm_slot_gain",
+        "slots": f"{st_unpaged['slots']} -> {st_2x['slots']}",
+        "kv_bytes_per_slot": f"{st_unpaged['kv_bytes_per_slot']} -> "
+                             f"{st_2x['kv_bytes_per_slot']}",
+        "value": round(st_2x["slots"] / st_unpaged["slots"], 2),
+    }), flush=True)

@@ -325,3 +325,114 @@ def test_train_and_decode_end_to_end_with_buckets(tiny_dataset, tmp_path):
 # buckets x fused_steps / accum_steps no longer raises: the grouped
 # scheduler (data/grouping.py) packs bucket-homogeneous K-groups — the
 # composition contract is pinned end-to-end in tests/test_grouping.py.
+
+
+# --------------------------------------------------------------------------
+# longer-target decode buckets (cfg.decode_tar_buckets; ISSUE 7 —
+# docs/DECODE_ENGINE.md "Paged KV arena"). The tiny-geometry analog of the
+# tar-30/tar-64 mix: full tar 12 with declared tar-4 and tar-8 buckets.
+# --------------------------------------------------------------------------
+
+TAR_BUCKETS = ((16, 256, 4), (16, 256, 8))
+
+
+def test_decode_table_tar_buckets_admissibility_and_assignment(corpus,
+                                                               extents):
+    cfg0, split = corpus
+    cfg = cfg0.replace(buckets=TAR_BUCKETS, decode_tar_buckets=True)
+
+    # default (tar pinned full): every decode bucket carries cfg.tar_len
+    pinned = B.decode_table(cfg0.replace(buckets=TAR_BUCKETS))
+    assert all(g.tar_len == cfg0.tar_len for g in pinned)
+    # tar-bucketed: each declared bucket KEEPS its own tar, full last
+    table = B.decode_table(cfg)
+    assert [g.tar_len for g in table] == [4, 8, cfg.tar_len]
+
+    # assignment by reference-message extent is smallest-admissible: a
+    # sample sits in the cheapest bucket whose tar fits its message, and
+    # in no cheaper one
+    assignment = B.assign_buckets(extents, table, use_msg=True)
+    for i, b in enumerate(assignment):
+        assert extents.admissible(table[b], use_msg=True)[i], i
+        for cheaper in range(b):
+            assert not extents.admissible(table[cheaper], use_msg=True)[i], i
+    # the fixture stream genuinely MIXES tar budgets (msg extents 4..7)
+    lens = {table[b].tar_len for b in assignment}
+    assert {4, 8} <= lens, np.bincount(assignment)
+    # admissibility respects the tar axis: msg-5 samples do NOT fit tar 4
+    over = extents.msg > 4
+    assert over.any()
+    assert not extents.admissible(table[0], use_msg=True)[over].any()
+
+
+def test_tar_bucketed_engine_file_bytes_deterministic(tiny_dataset,
+                                                      tmp_path):
+    """A stream mixing tar-4 and tar-8 commits through the paged engine:
+    output file bytes are a pure function of the stream — invariant to
+    slot count, pool size, refill order, AND replica count — with zero
+    post-warmup compiles under the declared (geometry incl. tar axis)
+    family. The batched beam is no comparator here (it always scans the
+    full budget; the per-slot cap is engine semantics), so determinism
+    across schedules IS the contract."""
+    from fira_tpu.data.batching import make_batch
+    from fira_tpu.decode.beam import eos_biased_params
+    from fira_tpu.decode.runner import run_test
+    from fira_tpu.train.state import init_state
+
+    ds = tiny_dataset
+    cfg = ds.cfg.replace(buckets=TAR_BUCKETS, decode_tar_buckets=True,
+                         decode_engine=True)
+    model = FiraModel(cfg)
+    batch = make_batch(ds.splits["train"], np.arange(4), cfg,
+                       batch_size=cfg.test_batch_size)
+    params = eos_biased_params(init_state(model, cfg, batch).params,
+                               delta=4.0)
+
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        ref = run_test(model, params, ds, cfg,
+                       out_dir=str(tmp_path / "ref"), split="train",
+                       guard=guard)
+    assert guard.compiles_after_warmup() == 0
+    # the warmed prefill family carries the tar axis in its labels
+    assert any(".t4]" in lbl for lbl in guard._seen), guard._seen
+    assert any(".t8]" in lbl for lbl in guard._seen), guard._seen
+    ref_bytes = open(ref["output_path"], "rb").read()
+    assert ref["engine"]["commits"] == len(ds.splits["train"])
+
+    variants = [
+        dict(engine_slots=3),                       # refill churn
+        dict(kv_pool_blocks=12),                    # undersized pool (full
+                                                    # residency is 24):
+                                                    # head-of-line blocks
+        dict(engine_harvest_every=1, engine_prefill_depth=3),
+        dict(engine_replicas=2),                    # fleet
+    ]
+    for i, over in enumerate(variants):
+        got = run_test(model, params, ds, cfg.replace(**over),
+                       out_dir=str(tmp_path / f"v{i}"), split="train",
+                       refill_order="lifo" if i % 2 else "fifo")
+        assert open(got["output_path"], "rb").read() == ref_bytes, over
+    # mixed reservations show up in the pool accounting: peak use is below
+    # full residency because tar-4/tar-8 slots reserve 2/4 blocks, not the
+    # full-tar 6 (auto block size: gcd(4,8,12)=4 capped at min_tar//2=2)
+    assert ref["engine"]["kv_block_size"] == 2
+    assert 0 < ref["engine"]["peak_blocks"] < ref["engine"]["pool_blocks"]
+
+
+def test_dev_gate_pins_tar_full_under_decode_tar_buckets(tiny_dataset):
+    """cfg.decode_tar_buckets is an ENGINE generation knob: the teacher-
+    forced dev gate must keep packing with the tar-PINNED decode table
+    (it scores every tar position, and its use_msg=False assignment would
+    otherwise seat long-message samples in short-tar buckets and trip
+    make_batch's admissibility backstop mid-train)."""
+    from fira_tpu.train.loop import _eval_tasks
+
+    ds = tiny_dataset
+    cfg = ds.cfg.replace(buckets=TAR_BUCKETS, decode_tar_buckets=True)
+    n = 0
+    with Feeder(_eval_tasks(ds.splits["valid"], cfg), num_workers=0,
+                depth=1) as feed:
+        for item in feed:
+            assert item.host["msg"].shape[1] == cfg.tar_len
+            n += int(item.host["valid"].sum())
+    assert n == len(ds.splits["valid"])

@@ -1,0 +1,347 @@
+"""Paged KV arena (decode/paging.py + decode/engine.py block tables).
+
+Pins the ISSUE-7 contract (docs/DECODE_ENGINE.md "Paged KV arena"):
+
+- the paged engine is per-sample BIT-EXACT (tokens AND probs) vs the
+  whole-sequence unpaged arena in all four kv-cache x factored-topk
+  modes, and run_test file bytes are identical (single engine AND
+  2-replica fleet) with zero post-warmup compiles;
+- scheduling stays deterministic when the pool is UNDERSIZED: admission
+  is head-of-line on block reservations, so output bytes are a pure
+  function of the stream, pool size included;
+- the no-zeroing INVARIANT: insert touches neither the paged pools nor
+  the unpaged cache stripes (freed blocks are unmapped, never zeroed —
+  beam.step_valid_mask makes unwritten positions an exact 0.0), and a
+  dirty arena reused across streams stays bit-exact, so the old
+  two-full-arena-scatters-per-refill zeroing cannot silently reappear;
+- parse-time paging-knob validation (decode/paging.paging_errors): named
+  -knob messages, CLI exit 2, and the fleet's per-replica pool split.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from fira_tpu.analysis import sanitizer
+from fira_tpu.config import fira_tiny
+from fira_tpu.data.dataset import FiraDataset
+from fira_tpu.data.feeder import Feeder
+from fira_tpu.data.synthetic import write_corpus_dir
+from fira_tpu.decode import engine as engine_lib
+from fira_tpu.decode import paging
+from fira_tpu.decode.beam import eos_biased_params
+from fira_tpu.decode.runner import _decode_tasks, run_test
+from fira_tpu.model.model import FiraModel
+from fira_tpu.parallel import fleet as fleet_lib
+from fira_tpu.train.state import init_state
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    data_dir = str(tmp_path_factory.mktemp("paged_corpus"))
+    write_corpus_dir(data_dir, n_commits=40, seed=23)
+    cfg = fira_tiny(batch_size=8, test_batch_size=6)
+    dataset = FiraDataset(data_dir, cfg)
+    cfg = dataset.cfg
+    from fira_tpu.data.batching import make_batch
+
+    batch = make_batch(dataset.splits["train"], np.arange(6), cfg)
+    params = init_state(FiraModel(cfg), cfg, batch).params
+    # moderate EOS bias: mixed settle depths => real harvest/refill churn,
+    # so freed blocks actually return to the pool and get re-granted dirty
+    return cfg, dataset, data_dir, eos_biased_params(params, delta=4.0)
+
+
+def _engine_outputs(model, params, dataset, cfg, **engine_kw):
+    """{split position: (tokens, probs)} from one engine drain."""
+    data = dataset.splits["train"]
+    eng = engine_lib.SlotEngine(model, params, cfg, **engine_kw)
+    tasks, _ = _decode_tasks(data, cfg)
+    out = {}
+    with Feeder(tasks, num_workers=0, depth=1) as feed:
+        for it in eng.run(feed):
+            out[it.position] = (it.tokens, it.probs)
+    assert len(out) == len(data)
+    return out, eng
+
+
+MODES = [
+    # (kv_cache, factored_topk)
+    (True, False),
+    (True, True),
+    (False, False),
+    (False, True),
+]
+
+
+@pytest.mark.parametrize("kv,fac", MODES)
+def test_paged_bit_exact_vs_unpaged(setup, kv, fac):
+    """Engine with the paged arena == engine with the whole-sequence
+    arena, per sample, bitwise (tokens AND probs) — the ROADMAP-4
+    regression contract, in every kv-cache x factored-topk mode."""
+    cfg0, dataset, _dir, eos_params = setup
+    cfg = dataclasses.replace(cfg0, beam_kv_cache=kv, beam_factored_topk=fac)
+    model = FiraModel(cfg)
+
+    paged_out, paged_eng = _engine_outputs(
+        model, eos_params, dataset,
+        dataclasses.replace(cfg, engine_paged_kv=True))
+    if not kv:
+        # no K/V cache => nothing to page: the knob must be inert, and the
+        # stats must carry no phantom pool (pool_utilization 0.0 = no
+        # cache HBM committed at all)
+        assert paged_eng._paged is False
+        assert paged_eng.stats.pool_blocks == 0
+        assert paged_eng.stats.pool_utilization == 0.0
+        return
+    assert paged_eng._paged is True
+    st = paged_eng.stats.summary()
+    # full-residency auto pool: every slot holds a whole-tar reservation
+    assert st["pool_blocks"] == paged_eng.slots * paged_eng._table_width
+    assert st["kv_block_size"] == paging.resolve_block_size(cfg)
+    assert st["kv_bytes_per_slot"] > 0
+    assert 0.0 < st["pool_utilization"] <= 1.0
+    assert 0 < st["peak_blocks"] <= st["pool_blocks"]
+
+    unpaged_out, unpaged_eng = _engine_outputs(
+        model, eos_params, dataset,
+        dataclasses.replace(cfg, engine_paged_kv=False))
+    assert unpaged_eng._paged is False
+    # the unpaged arena commits its whole-sequence stripes whether or not
+    # a slot is live: utilization pinned 1.0, the HBM the pool stops paying
+    assert unpaged_eng.stats.pool_utilization == 1.0
+    assert (unpaged_eng.stats.kv_bytes_per_slot
+            == paged_eng.stats.kv_bytes_per_slot)  # full residency: equal HBM
+
+    assert paged_out.keys() == unpaged_out.keys()
+    for pos in paged_out:
+        np.testing.assert_array_equal(paged_out[pos][0], unpaged_out[pos][0])
+        np.testing.assert_array_equal(paged_out[pos][1], unpaged_out[pos][1])
+
+
+def test_paged_file_identical_zero_retraces_single_and_fleet(setup, tmp_path):
+    """run_test bytes + BLEU: paged == unpaged on a BUCKETED stream, with
+    zero post-warmup compiles under the armed sanitizer for the paged
+    single engine AND the paged 2-replica fleet (the paged step/insert
+    programs live under the SAME declared label family)."""
+    cfg0, dataset, _dir, eos_params = setup
+    cfg = dataclasses.replace(cfg0, buckets=((16, 400, 12),),
+                              decode_engine=True)
+    model = FiraModel(cfg)
+    ref = run_test(model, eos_params, dataset,
+                   dataclasses.replace(cfg, engine_paged_kv=False),
+                   out_dir=str(tmp_path / "unpaged"), split="train")
+    ref_bytes = open(ref["output_path"], "rb").read()
+
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        one = run_test(model, eos_params, dataset, cfg,
+                       out_dir=str(tmp_path / "paged1"), guard=guard,
+                       split="train")
+        assert guard.compiles_after_warmup() == 0
+    assert open(one["output_path"], "rb").read() == ref_bytes
+    assert one["sentence_bleu"] == ref["sentence_bleu"]
+    assert one["engine"]["pool_blocks"] > 0
+    assert one["engine"]["kv_bytes_per_slot"] > 0
+
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        two = run_test(model, eos_params, dataset,
+                       dataclasses.replace(cfg, engine_replicas=2),
+                       out_dir=str(tmp_path / "paged2"), guard=guard,
+                       split="train")
+        assert guard.compiles_after_warmup() == 0
+    assert open(two["output_path"], "rb").read() == ref_bytes
+    eng = two["engine"]
+    assert eng["replicas"] == 2
+    # per-chip pools total across the fleet; utilization stays a mean over
+    # each replica's own dispatches
+    assert eng["pool_blocks"] == 2 * one["engine"]["pool_blocks"]
+    assert eng["kv_bytes_per_slot"] == one["engine"]["kv_bytes_per_slot"]
+    assert 0.0 < eng["pool_utilization"] <= 1.0
+
+
+def test_undersized_pool_head_of_line_deterministic(setup, tmp_path):
+    """A pool SMALLER than full residency (here: a third) forces
+    reservation-based admission — at most pool/W slots live at once — yet
+    output bytes are identical: refill is head-of-line on the block free
+    list, so admission order is a pure function of the stream."""
+    cfg0, dataset, _dir, eos_params = setup
+    cfg = dataclasses.replace(cfg0, decode_engine=True, engine_slots=6)
+    model = FiraModel(cfg)
+    ref = run_test(model, eos_params, dataset, cfg,
+                   out_dir=str(tmp_path / "full"), split="train")
+    W = paging.blocks_per_seq(cfg.tar_len, paging.resolve_block_size(cfg))
+    pool = 2 * W  # room for TWO of the six slots
+    small = run_test(model, eos_params, dataset,
+                     dataclasses.replace(cfg, kv_pool_blocks=pool),
+                     out_dir=str(tmp_path / "small"), split="train")
+    assert (open(small["output_path"], "rb").read()
+            == open(ref["output_path"], "rb").read())
+    st = small["engine"]
+    assert st["pool_blocks"] == pool
+    assert 0 < st["peak_blocks"] <= pool
+    # the block cap binds: full residency would seat all six slots
+    assert ref["engine"]["peak_blocks"] > pool
+
+
+def test_insert_never_zeroes_cache_and_dirty_arena_reuse(setup, tmp_path):
+    """The comment-backed INVARIANT of engine._insert_fn: insert must not
+    touch the K/V buffers in EITHER arena — paged pools get new block
+    GRANTS (table rows), unpaged stripes get nothing (stale positions are
+    -1e9-masked to an exact 0.0 by beam.step_valid_mask). Pinned by
+    object identity through an EAGER insert, so a reintroduced zeroing
+    scatter fails here even before any output diverges — plus bit-exact
+    file bytes from a deliberately DIRTY arena reused across streams."""
+    cfg0, dataset, _dir, eos_params = setup
+    data = dataset.splits["train"]
+
+    for paged, fields in ((True, ("k_pool", "v_pool")),
+                          (False, ("k_cache", "v_cache"))):
+        cfg = dataclasses.replace(cfg0, beam_kv_cache=True,
+                                  engine_paged_kv=paged)
+        model = FiraModel(cfg)
+        eng = engine_lib.SlotEngine(model, eos_params, cfg)
+        tasks, _ = _decode_tasks(data, cfg)
+        with Feeder(tasks, num_workers=0, depth=1) as feed:
+            for _ in eng.run(feed):
+                pass
+        state = eng._state  # dirty: every slot has decoded real samples
+        from fira_tpu.data.batching import make_batch
+
+        host = make_batch(data, np.arange(cfg.test_batch_size), cfg,
+                          batch_size=cfg.test_batch_size)
+        chunk = eng._prefill(eng.params, host)
+        C = host["valid"].shape[0]
+        slot_ids = np.arange(C, dtype=np.int32)
+        limits = np.full((C,), cfg.tar_len, np.int32)
+        block_rows = None
+        if paged:
+            W = eng._table_width
+            block_rows = np.arange(C * W, dtype=np.int32).reshape(C, W)
+        new = eng._insert_fn(state, chunk, slot_ids, limits, block_rows)
+        for f in fields:
+            assert new[f] is state[f], (
+                f"insert touched {f}: the no-zeroing invariant broke — "
+                f"freed blocks/stripes must be unmapped, never zeroed")
+
+    # dirty-arena reuse: second drain of the SAME engine starts from pools
+    # full of the first drain's values; bytes must not change
+    cfg = dataclasses.replace(cfg0, decode_engine=True)
+    model = FiraModel(cfg)
+    runs = []
+    eng = engine_lib.SlotEngine(model, eos_params, cfg)
+    for _ in range(2):
+        tasks, _ = _decode_tasks(data, cfg)
+        got = {}
+        with Feeder(tasks, num_workers=0, depth=1) as feed:
+            for it in eng.run(feed):
+                got[it.position] = (it.tokens.tobytes(), it.probs.tobytes())
+        runs.append(got)
+    assert runs[0] == runs[1]
+
+
+# --------------------------------------------------------------------------
+# knob resolution + parse-time validation
+# --------------------------------------------------------------------------
+
+def test_auto_block_size_and_byte_accounting():
+    assert paging.auto_block_size((12,)) == 6
+    assert paging.auto_block_size((8, 12)) == 4    # gcd 4, cap 4
+    assert paging.auto_block_size((30,)) == 15
+    assert paging.auto_block_size((30, 64)) == 2   # gcd 2
+    assert paging.auto_block_size((7,)) == 1       # prime: always valid
+    assert paging.blocks_per_seq(30, 15) == 2
+    assert paging.blocks_per_seq(31, 15) == 3
+    cfg = fira_tiny()
+    bs = paging.resolve_block_size(cfg)
+    W = paging.blocks_per_seq(cfg.tar_len, bs)
+    slots = 8
+    # full residency: the paged pool commits exactly the unpaged bytes
+    assert paging.kv_bytes_per_slot(
+        cfg, paged=True, block_size=bs, pool_blocks=slots * W, slots=slots,
+        itemsize=4) == paging.kv_bytes_per_slot(
+        cfg, paged=False, block_size=0, pool_blocks=0, slots=slots,
+        itemsize=4)
+    # half the pool: half the committed HBM per slot
+    assert paging.kv_bytes_per_slot(
+        cfg, paged=True, block_size=bs, pool_blocks=slots * W // 2,
+        slots=slots, itemsize=4) == paging.kv_bytes_per_slot(
+        cfg, paged=False, block_size=0, pool_blocks=0, slots=slots,
+        itemsize=4) // 2
+
+
+def test_paging_errors_named_knob_messages():
+    base = fira_tiny().replace(decode_engine=True)  # beam_kv_cache defaults on
+
+    assert paging.paging_errors(base) == []  # auto knobs always admissible
+    # paging disabled (either knob) => nothing to validate
+    assert paging.paging_errors(base.replace(engine_paged_kv=False,
+                                             kv_block_size=5)) == []
+    assert paging.paging_errors(base.replace(decode_engine=False,
+                                             kv_block_size=5)) == []
+
+    errs = paging.paging_errors(base.replace(kv_block_size=5))
+    assert len(errs) == 1 and "does not divide decode tar budget 12" in errs[0]
+
+    # under decode_tar_buckets every bucket tar joins the declared set
+    tarred = base.replace(buckets=((16, 400, 8),), decode_tar_buckets=True)
+    errs = paging.paging_errors(tarred.replace(kv_block_size=6))
+    assert len(errs) == 1 and "budget 8" in errs[0]
+    assert paging.paging_errors(tarred.replace(kv_block_size=4)) == []
+
+    # pool floors: slots x ceil(smallest tar / block), then one worst-case
+    # sample (the no-livelock floor); fira_tiny test_batch_size=8, W=2
+    errs = paging.paging_errors(base.replace(kv_pool_blocks=10))
+    assert len(errs) == 1 and "every slot servable" in errs[0]
+    errs = paging.paging_errors(base.replace(engine_slots=1,
+                                             kv_pool_blocks=1))
+    assert any("livelock" in e for e in errs)
+    assert paging.paging_errors(base.replace(kv_pool_blocks=16)) == []
+
+    # the fleet splits the pool TOTAL evenly, like engine_slots
+    errs = paging.paging_errors(base.replace(engine_replicas=2,
+                                             kv_pool_blocks=7))
+    assert len(errs) == 1 and "engine_replicas 2" in errs[0]
+    assert paging.paging_errors(base.replace(engine_replicas=2,
+                                             engine_slots=8,
+                                             kv_pool_blocks=16)) == []
+
+
+def test_cli_exits_2_on_paging_knobs(setup, tmp_path):
+    """Parse-time rejection with named-knob messages — not a mid-run
+    shape error (the exit-2 contract of parallel.mesh/fleet)."""
+    from fira_tpu import cli
+
+    _cfg, _dataset, data_dir, _params = setup
+    base = ["test", "--data-dir", data_dir, "--config", "fira-tiny",
+            "--engine", "--out-dir", str(tmp_path / "o")]
+    assert cli.main(base + ["--kv-block-size", "5"]) == 2
+    assert cli.main(base + ["--kv-pool-blocks", "10"]) == 2
+    assert cli.main(base + ["--engine-replicas", "2",
+                            "--kv-pool-blocks", "7"]) == 2
+    # --kv-paged off makes the same knobs inert: an invalid block size
+    # must NOT exit 2 (nothing is paged) — the run then fails on the
+    # missing checkpoint (rc 1), i.e. it got PAST parse-time validation
+    rc = cli.main(base + ["--kv-paged", "off", "--kv-block-size", "5"])
+    assert rc == 1
+
+
+def test_fleet_pool_split_per_replica(setup):
+    cfg0, _dataset, _dir, params = setup
+    cfg = dataclasses.replace(cfg0, decode_engine=True)
+    model = FiraModel(cfg)
+    fleet = fleet_lib.EngineFleet(
+        model, params, dataclasses.replace(cfg, kv_pool_blocks=8),
+        replicas=2)
+    assert [e._pool_blocks for e in fleet.engines] == [4, 4]
+    with pytest.raises(ValueError, match="kv_pool_blocks 7"):
+        fleet_lib.EngineFleet(
+            model, params, dataclasses.replace(cfg, kv_pool_blocks=7),
+            replicas=2)
+    # the parse-time split check is OWNED by paging_errors (the CLI runs
+    # it right after fleet_divisibility_errors, which must NOT duplicate
+    # the message)
+    bad = dataclasses.replace(cfg, engine_replicas=2, kv_pool_blocks=7)
+    assert [e for e in fleet_lib.fleet_divisibility_errors(bad)
+            if "kv_pool_blocks" in e] == []
+    assert any("kv_pool_blocks 7" in e for e in paging.paging_errors(bad))
