@@ -131,6 +131,12 @@ fleet at 1/2/4/8 virtual CPU devices, one fresh subprocess per count —
 and folds its per-device-count rows into this record; the full artifact
 lands in MULTICHIP_r06.json. FIRA_BENCH_MULTICHIP_TIMEOUT caps the whole
 sweep, default 1800 s),
+FIRA_BENCH_SERVE=1 (opt-in online-serving leg: runs
+scripts/serve_bench.py — open-loop Poisson offered-rate sweep + the
+prefill-budget A/B over the serving loop, fira_tpu/serve — and folds its
+p50/p99 TTFT / e2e latency rows and the saturation knee into this
+record; the full artifact lands in docs/SERVE_BENCH_r01.jsonl.
+FIRA_BENCH_SERVE_TIMEOUT caps the sweep, default 900 s),
 
 Composed leg — the production path going forward (ISSUE 4): the stacked
 knobs AND the auto bucket table together. One shuffled epoch plan of
@@ -777,6 +783,34 @@ def worker() -> None:
             print(f"multichip leg failed: {e!r}", file=sys.stderr)
             multichip = {"error": repr(e)}
 
+    # (g) SERVE leg (opt-in: FIRA_BENCH_SERVE=1): the online-serving
+    # latency story — scripts/serve_bench.py sweeps open-loop Poisson
+    # offered rates over the serving loop (fira_tpu/serve) and emits
+    # p50/p99 TTFT + e2e latency per rate, the saturation knee, and the
+    # prefill-budget A/B. One subprocess (it owns its synthetic corpus
+    # and forces the CPU backend); failures degrade to a structured
+    # error field, never sinking the main measurement.
+    serve = None
+    if os.environ.get("FIRA_BENCH_SERVE", "0") == "1":
+        try:
+            script = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "scripts", "serve_bench.py")
+            p = subprocess.run(
+                [sys.executable, script], text=True,
+                timeout=float(os.environ.get(
+                    "FIRA_BENCH_SERVE_TIMEOUT", "900")),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            rec = _last_json_line(p.stdout or "")
+            if p.returncode == 0 and rec and rec.get("rows"):
+                serve = {"rows": rec["rows"]}
+            else:
+                serve = {"error": f"rc={p.returncode}",
+                         "tail": (p.stderr or p.stdout or "")[-300:]}
+        except Exception as e:
+            print(f"serve leg failed: {e!r}", file=sys.stderr)
+            serve = {"error": repr(e)}
+
     step_time = dt_e2e / steps_per_window
     compute_step_time = dt_compute / steps_per_window
     # metric of record: chip-side throughput (see module docstring "History
@@ -828,6 +862,9 @@ def worker() -> None:
         # multi-chip scaling rows (FIRA_BENCH_MULTICHIP=1; the full
         # artifact is MULTICHIP_r06.json — scripts/multichip_bench.py)
         **({"multichip": multichip} if multichip else {}),
+        # online-serving latency rows (FIRA_BENCH_SERVE=1; the full
+        # artifact is docs/SERVE_BENCH_r01.jsonl — scripts/serve_bench.py)
+        **({"serve": serve} if serve else {}),
         "feed_stall_frac_sync_assembly": sync_info["feed_stall_frac"],
         "value_e2e_sync_assembly": round(
             batch_size / (dt_sync / steps_per_window) / n_chips, 2),

@@ -52,6 +52,7 @@ _DRIVER_FILES = (
     "fira_tpu/data/feeder.py", "fira_tpu/data/buckets.py",
     "fira_tpu/data/grouping.py",
     "fira_tpu/parallel/fleet.py",
+    "fira_tpu/serve/server.py",
 )
 
 

@@ -25,9 +25,13 @@ from typing import List, Optional
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="fira_tpu", description=__doc__)
-    p.add_argument("command", choices=["train", "test", "preprocess"],
+    p.add_argument("command", choices=["train", "test", "serve",
+                                       "preprocess"],
                    help="train: fit + dev-gate; test: beam-decode the test "
-                        "split; preprocess: raw diffs -> DataSet/ corpus")
+                        "split; serve: decode the test split as a "
+                        "long-lived server under open-loop arrival-timed "
+                        "load (docs/SERVING.md); preprocess: raw diffs -> "
+                        "DataSet/ corpus")
     p.add_argument("--backend", default="jax", choices=["jax"],
                    help="compute backend (this framework is TPU/JAX-native)")
     p.add_argument("--config", default="fira-full",
@@ -143,6 +147,43 @@ def build_parser() -> argparse.ArgumentParser:
                         "reservation) at that budget. The longer-target "
                         "door: raise the config tar_len and declare the "
                         "common case as a bucket")
+    p.add_argument("--serve-rate", type=float, default=None, metavar="RPS",
+                   help="serve: offered load in requests/second for the "
+                        "open-loop Poisson arrival generator; required "
+                        "(> 0) unless --serve-trace replays a recorded "
+                        "schedule (validated at parse time, exit 2)")
+    p.add_argument("--serve-trace", default=None, metavar="PATH",
+                   help="serve: replay this arrival-trace file (one "
+                        "non-decreasing arrival time per line, line i = "
+                        "test-split position i — serve/arrivals.py) "
+                        "instead of generating Poisson arrivals; replayed "
+                        "traces make serving runs deterministic")
+    p.add_argument("--serve-prefill-budget", type=int, default=None,
+                   metavar="P",
+                   help="serve: max prefill dispatches interleaved between "
+                        "step dispatches per replica (default 1 — the "
+                        "latency-lean setting; must be >= 1 and <= the "
+                        "per-replica slot count, validated at parse time, "
+                        "exit 2). Higher trades seated requests' tail "
+                        "latency for admission throughput")
+    p.add_argument("--serve-deadline-steps", type=int, default=None,
+                   metavar="D",
+                   help="serve: per-request deadline in step dispatches — "
+                        "a request still queued after D steps is shed "
+                        "(recorded, never a hang). 0 = none (default); "
+                        "must be 0 or >= 1 (validated at parse time, "
+                        "exit 2)")
+    p.add_argument("--serve-queue-cap", type=int, default=None, metavar="Q",
+                   help="serve: admission-queue bound — an arrival past Q "
+                        "queued requests is rejected on the spot "
+                        "(structured backpressure; recorded). 0 = "
+                        "unbounded (default)")
+    p.add_argument("--serve-clock", default="wall",
+                   choices=["wall", "virtual"],
+                   help="serve: 'wall' (default) paces arrivals in real "
+                        "time — the latency-measurement mode; 'virtual' "
+                        "advances a deterministic unit clock per dispatch "
+                        "— the replayable-trace equivalence mode")
     p.add_argument("--beam-log-space", action="store_true",
                    help="log-space beam accumulation instead of the "
                         "reference-compat probability space")
@@ -270,6 +311,19 @@ def _resolve_cfg(args):
         overrides["kv_pool_blocks"] = args.kv_pool_blocks
     if args.decode_tar_buckets:
         overrides["decode_tar_buckets"] = True
+    # serve runs ON the slot engine: the serving loop drives the engine's
+    # steppable scheduler pieces, so the engine path (and its parse-time
+    # fleet/paging validation) is implied by the command itself
+    if args.command == "serve":
+        overrides["decode_engine"] = True
+    if args.serve_rate is not None:
+        overrides["serve_rate"] = args.serve_rate
+    if args.serve_prefill_budget is not None:
+        overrides["serve_prefill_budget"] = args.serve_prefill_budget
+    if args.serve_deadline_steps is not None:
+        overrides["serve_deadline_steps"] = args.serve_deadline_steps
+    if args.serve_queue_cap is not None:
+        overrides["serve_queue_cap"] = args.serve_queue_cap
     if args.adjacency:
         overrides["adjacency_impl"] = args.adjacency
     if args.encoder_buffer:
@@ -395,6 +449,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from fira_tpu.decode.paging import paging_errors
 
         errs += paging_errors(cfg)
+    if args.command == "serve":
+        # serving knob admission (offered rate, prefill budget vs slots,
+        # deadline floor, queue bound) — same exit-2 contract,
+        # serve.server.serve_errors
+        from fira_tpu.serve.server import serve_errors
+
+        errs += serve_errors(cfg, trace=args.serve_trace is not None)
     if errs:
         for e in errs:
             print(f"parse-time validation: {e}", file=sys.stderr)
@@ -426,7 +487,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"feed_stall_frac: {result.feed_stall_frac:.3f}")
         return 0
 
-    # test: load best params, beam-decode, write OUTPUT file
+    # test/serve: load best params, beam-decode, write OUTPUT file
     import jax
 
     from fira_tpu.decode.runner import output_name, run_test
@@ -457,6 +518,44 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("no best checkpoint (dev BLEU never improved); "
               "decoding the LATEST training state", file=sys.stderr)
         params = ckpt.restore_latest(template)[0].params
+
+    if args.command == "serve":
+        from fira_tpu.serve import poisson_times, read_trace, serve_split
+
+        n_req = len(split)
+        if args.serve_trace:
+            times = read_trace(args.serve_trace)
+            if len(times) > n_req:
+                print(f"parse-time validation: --serve-trace has "
+                      f"{len(times)} arrivals but the test split holds "
+                      f"only {n_req} samples", file=sys.stderr)
+                return 2
+        else:
+            times = poisson_times(n_req, cfg.serve_rate, seed=cfg.seed)
+        metrics = serve_split(model, params, dataset, cfg,
+                              arrival_times=times, out_dir=args.out_dir,
+                              ablation=args.ablation, var_maps=var_maps,
+                              guard=guard, clock=args.serve_clock)
+        sv = metrics["serve"]
+        metrics_path = os.path.join(args.out_dir, "serve_metrics.json")
+        # shed requests carry NaN lifecycle stamps (they were never
+        # seated); serialize them as null — bare NaN tokens would make
+        # the advertised machine-readable artifact invalid strict JSON
+        records = [{k: (None if isinstance(v, float) and v != v else v)
+                    for k, v in r.items()}
+                   for r in metrics["request_records"]]
+        with open(metrics_path, "w") as f:
+            json.dump({"serve": sv, "engine": metrics["engine"],
+                       "request_records": records},
+                      f, indent=1, allow_nan=False)
+        print(f"serve: {sv['completed']}/{sv['offered']} completed "
+              f"(shed {sv['shed_queue_full']} queue-full, "
+              f"{sv['shed_deadline']} deadline)  "
+              f"p50/p99 ttft {sv['p50_ttft_s']}/{sv['p99_ttft_s']} s  "
+              f"p50/p99 e2e {sv['p50_e2e_s']}/{sv['p99_e2e_s']} s  "
+              f"-> {metrics_path}")
+        return 0
+
     metrics = run_test(model, params, dataset, cfg, out_dir=args.out_dir,
                        ablation=args.ablation, var_maps=var_maps,
                        guard=guard)

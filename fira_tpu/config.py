@@ -223,6 +223,33 @@ class FiraConfig:
     # interleaving (tests/test_fleet.py).
     engine_replicas: int = 1
 
+    # --- online serving (serve/; docs/SERVING.md) ---
+    # Offered load in requests/second for the open-loop Poisson arrival
+    # generator (serve/arrivals.poisson_times). Only read by the serve
+    # driver when no arrival-trace file is given; must then be > 0
+    # (validated at parse time, CLI exit 2 — serve.server.serve_errors).
+    serve_rate: float = 0.0
+    # Latency-aware refill: the maximum prefill dispatches interleaved
+    # between consecutive step dispatches, PER REPLICA. Every prefill
+    # admitted mid-stream stalls the seated slots' next decode step, so
+    # a small budget bounds the per-admission stall seated requests pay
+    # (tail latency) while a large one maximizes admission throughput —
+    # the A/B knob of the serve bench. Must be >= 1 and <= the
+    # per-replica slot count (validated at parse time, exit 2).
+    serve_prefill_budget: int = 1
+    # Per-request deadline in STEP DISPATCHES (the scheduler's clock-free
+    # time unit): a request still queued after this many step dispatches
+    # since its arrival is SHED (recorded, never a hang); a seated
+    # request always runs to harvest and a late completion is flagged,
+    # not killed. 0 = no deadline. Must be 0 or >= 1 — a request cannot
+    # complete in less than one step (validated at parse time, exit 2).
+    serve_deadline_steps: int = 0
+    # Admission-queue bound: an arrival that finds this many requests
+    # already queued is rejected on the spot (structured shed-on-
+    # backpressure — the rejection is recorded in ServeStats and the
+    # output file keeps the position with an empty line). 0 = unbounded.
+    serve_queue_cap: int = 0
+
     # --- typed edges (beyond-parity extension) ---
     # The reference computes six edge families then flattens them into one
     # untyped adjacency (process_edge's `kind` is dead, Dataset.py:346-357;

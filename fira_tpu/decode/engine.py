@@ -15,7 +15,8 @@ with per-batch max length.
 
 Program family (all fixed-shape, labelled for the compile guard —
 ``engine_prefill[<geom>]`` x the decode bucket table, ``engine_step``,
-``engine_insert``; zero post-warmup retraces):
+``engine_insert``, ``engine_harvest`` (the sliced-readback row gather);
+zero post-warmup retraces):
 
 - **prefill** (one per decode bucket geometry): encoder forward + per-beam
   cross-attention K/V + copy-head source projection for ONE packed batch
@@ -117,6 +118,7 @@ from fira_tpu.model.model import FiraModel
 PREFILL_KIND = "engine_prefill"
 STEP_LABEL = "engine_step"
 INSERT_LABEL = "engine_insert"
+HARVEST_LABEL = "engine_harvest"
 
 
 @dataclasses.dataclass
@@ -141,6 +143,12 @@ class EngineStats:
     kv_bytes_per_slot: int = 0   # committed K+V cache HBM per slot
     block_steps: int = 0         # blocks in use, summed per step dispatch
     peak_blocks: int = 0         # high-water mark of blocks in use
+    # sliced-harvest readback accounting: harvest copies ONLY the settled
+    # slots' token/prob rows D2H (one jitted dynamic-index gather per
+    # row) instead of the full (S, K, T) / (S, K) arenas per harvest
+    harvest_row_reads: int = 0   # settled-slot rows read back individually
+    harvest_bytes_read: int = 0  # token/prob bytes actually copied D2H
+    harvest_bytes_saved: int = 0  # vs the historical full-arena readback
 
     @property
     def slot_occupancy(self) -> float:
@@ -184,6 +192,9 @@ class EngineStats:
             "kv_bytes_per_slot": self.kv_bytes_per_slot,
             "peak_blocks": self.peak_blocks,
             "pool_utilization": round(self.pool_utilization, 4),
+            "harvest_row_reads": self.harvest_row_reads,
+            "harvest_bytes_read": self.harvest_bytes_read,
+            "harvest_bytes_saved": self.harvest_bytes_saved,
         }
 
 
@@ -270,6 +281,14 @@ class SlotEngine:
         # holds exactly one live state, rebound on every dispatch
         self._step = jax.jit(self._step_fn, donate_argnums=(1,))
         self._insert = jax.jit(self._insert_fn, donate_argnums=(0,))
+        # sliced harvest readback: one tiny program gathers a SINGLE
+        # settled slot's (tokens, probs) rows so the D2H copy is the
+        # slot's own bytes, not the whole (S, K, T) arena. dynamic_index
+        # keeps the slot id a runtime value — one compile for any slot,
+        # not one per slot constant.
+        self._take_rows = jax.jit(lambda tokens, probs, slot: (
+            jax.lax.dynamic_index_in_dim(tokens, slot, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(probs, slot, 0, keepdims=False)))
         self._pending_occ = None
         self.begin_stream()
 
@@ -285,12 +304,13 @@ class SlotEngine:
     def labels(self, table=None) -> List[str]:
         """This engine's full declared program family: one prefill label
         per decode bucket geometry (or the untagged prefill when no table)
-        plus step + insert."""
+        plus step + insert + the sliced-harvest row gather."""
         from fira_tpu.data.buckets import geom_tag
 
         prefills = ([self.label(PREFILL_KIND, geom_tag(g)) for g in table]
                     if table is not None else [self.label(PREFILL_KIND)])
-        return prefills + [self.label(STEP_LABEL), self.label(INSERT_LABEL)]
+        return prefills + [self.label(STEP_LABEL), self.label(INSERT_LABEL),
+                           self.label(HARVEST_LABEL)]
 
     # --- jitted programs -------------------------------------------------
 
@@ -662,6 +682,16 @@ class SlotEngine:
     def in_flight(self) -> int:
         return len(self._busy)
 
+    def in_flight_positions(self) -> List[int]:
+        """Split positions currently seated in slots (the serving loop
+        stamps seat/first-step latencies off this — serve/server.py)."""
+        return [pid for (pid, _host, _row) in self._busy.values()]
+
+    @property
+    def staged_rows(self) -> int:
+        """Admitted (prefilled) rows not yet seated in a slot."""
+        return self._staged_rows
+
     def admit(self, host: Dict, index: int, device_batch=None) -> None:
         """Prefill one packed batch and stage its real rows for refill.
         ``device_batch``: the feeder's already-transferred wire batch;
@@ -759,20 +789,32 @@ class SlotEngine:
             st.block_steps += used
             st.peak_blocks = max(st.peak_blocks, used)
 
-    def harvest(self) -> Iterator[EngineItem]:
-        """Read back the dispatched step's done mask and yield every newly
-        settled slot's sample. COPIES, not views: the next dispatch DONATES
-        these buffers, and on the CPU backend a zero-copy device_get view
-        into a donated buffer dangles."""
+    def harvest(self) -> List[EngineItem]:
+        """Read back the dispatched step's done mask and return every
+        newly settled slot's sample. The readback is SLICED: one jitted
+        dynamic-index gather per settled slot copies only that slot's
+        (tokens, probs) rows D2H instead of the whole arena per harvest —
+        the saved bytes are metered (``harvest_bytes_saved``). COPIES,
+        not views: the next dispatch DONATES the arena buffers, and on
+        the CPU backend a zero-copy device_get view into a donated buffer
+        dangles. Items are materialized EAGERLY (a plain list, not a lazy
+        generator) for the same reason: a caller interleaving refill()
+        between items would donate the arena out from under a pending
+        row gather."""
         stats = self.stats
         stats.occupied_slot_steps += int(np.array(
             jax.device_get(self._pending_occ)))
         done = np.array(jax.device_get(self._state["done"]))
         newly = [s for s in self._busy if done[s]]
+        items: List[EngineItem] = []
         if newly:
-            toks = np.array(jax.device_get(self._state["tokens"]))
-            probs = np.array(jax.device_get(self._state["probs"]))
+            tokens, probs = self._state["tokens"], self._state["probs"]
+            full_bytes = tokens.nbytes + probs.nbytes
+            row_bytes = full_bytes // self.slots
             for s in newly:
+                toks_s, probs_s = self._take_rows(tokens, probs,
+                                                  jnp.int32(s))
+                self._guard_step(self.label(HARVEST_LABEL))
                 pos_id, host, r = self._busy.pop(s)
                 self._free.append(s)
                 # the slot's block grant returns WHOLE — contents stay as
@@ -780,8 +822,14 @@ class SlotEngine:
                 # grantee's validity mask makes them an exact 0.0)
                 self._free_blocks.extend(self._slot_blocks.pop(s, ()))
                 stats.commits += 1
-                yield EngineItem(position=pos_id, host=host, row=r,
-                                 tokens=toks[s], probs=probs[s])
+                stats.harvest_row_reads += 1
+                stats.harvest_bytes_read += row_bytes
+                items.append(EngineItem(
+                    position=pos_id, host=host, row=r,
+                    tokens=np.array(jax.device_get(toks_s)),  # firacheck: allow[HOST-SYNC] harvest IS the engine's designated output boundary: settled beams must reach the host to be cooked into text, and the sliced row gather is exactly the copy this readback exists to make
+                    probs=np.array(jax.device_get(probs_s))))  # firacheck: allow[HOST-SYNC] same harvest output boundary as the line above
+            stats.harvest_bytes_saved += full_bytes - row_bytes * len(newly)
+        return items
 
     def run(self, feed, *, refill_order: str = "fifo"
             ) -> Iterator[EngineItem]:

@@ -52,6 +52,37 @@ def output_name(ablation: Optional[str]) -> str:
     return f"output_fira_{ablation}"
 
 
+def sample_emitter(writer, *, vocab, cfg: FiraConfig, bleu_by_pos: Dict,
+                   n_total: int, var_maps=None, indices=None):
+    """The per-sample tail every decode driver shares (batched beam, slot
+    engine, fleet, and the serving loop — serve/server.py): pick the
+    argmax beam, cook text, score BLEU, de-anonymize, write at the
+    sample's split position."""
+
+    def emit(pos, host, row, tokens, probs):
+        best = int(np.argmax(probs))             # run_model.py:351
+        ids = tokens[best].tolist()
+        # beam output ids are already copy-resolved at extension time
+        hyp = cook_prediction(ids[1:], host["diff"][row],
+                              host["sub_token"][row], vocab, cfg,
+                              resolve=False)
+        ref = reference_words(host["msg"][row], vocab)
+        # keyed by position, summed in split order at the end: samples
+        # settle in scheduler order (engine/fleet/serve), and float
+        # addition in settle order would make the aggregate depend on
+        # replica count / refill interleaving in the last ulp
+        bleu_by_pos[pos] = nltk_sentence_bleu([ref], hyp)
+        n = len(bleu_by_pos)
+        var_map = (var_maps[indices[pos]]
+                   if var_maps is not None else None)
+        writer.add(pos, " ".join(deanonymize(hyp, var_map)) + "\n")
+        if n % 1000 == 0:
+            writer.flush()
+            print(f"decode: {n}/{n_total}", flush=True)
+
+    return emit
+
+
 def _decode_tasks(data, cfg: FiraConfig):
     """The packed decode stream: (tasks, decode bucket table or None).
     Shared by both decode paths — the engine prefills EXACTLY the batches
@@ -103,32 +134,9 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
     engine_stats = None
 
     def make_emit(writer):
-        """The per-sample tail both decode paths share: pick the argmax
-        beam, cook text, score BLEU, de-anonymize, write at the sample's
-        split position."""
-
-        def emit(pos, host, row, tokens, probs):
-            best = int(np.argmax(probs))             # run_model.py:351
-            ids = tokens[best].tolist()
-            # beam output ids are already copy-resolved at extension time
-            hyp = cook_prediction(ids[1:], host["diff"][row],
-                                  host["sub_token"][row], vocab, cfg,
-                                  resolve=False)
-            ref = reference_words(host["msg"][row], vocab)
-            # keyed by position, summed in split order at the end: samples
-            # settle in scheduler order (engine/fleet), and float addition
-            # in settle order would make the aggregate depend on replica
-            # count / refill interleaving in the last ulp
-            bleu_by_pos[pos] = nltk_sentence_bleu([ref], hyp)
-            n = len(bleu_by_pos)
-            var_map = (var_maps[indices[pos]]
-                       if var_maps is not None else None)
-            writer.add(pos, " ".join(deanonymize(hyp, var_map)) + "\n")
-            if n % 1000 == 0:
-                writer.flush()
-                print(f"decode: {n}/{n_total}", flush=True)
-
-        return emit
+        return sample_emitter(writer, vocab=vocab, cfg=cfg,
+                              bleu_by_pos=bleu_by_pos, n_total=n_total,
+                              var_maps=var_maps, indices=indices)
 
     if cfg.decode_engine:
         n_rep = max(1, int(cfg.engine_replicas))

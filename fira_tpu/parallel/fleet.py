@@ -107,6 +107,11 @@ class FleetStats:
             "step_dispatches": tot("step_dispatches"),
             "commits": self.commits,
             "dispatches": sum(r.dispatches for r in self.replicas),
+            # sliced-harvest readback accounting (decode/engine.py):
+            # per-replica D2H bytes total across the fleet
+            "harvest_row_reads": tot("harvest_row_reads"),
+            "harvest_bytes_read": tot("harvest_bytes_read"),
+            "harvest_bytes_saved": tot("harvest_bytes_saved"),
             # fleet-wide mean fraction of slots doing real beam work
             "slot_occupancy": round(
                 tot("occupied_slot_steps") / steps_x_slots, 4

@@ -1,0 +1,19 @@
+"""Online serving on the slot engine (docs/SERVING.md).
+
+The continuous-batching engine (decode/engine.py) and its replicated
+fleet (parallel/fleet.py) drain a static, pre-packed corpus stream —
+throughput numbers, no latency story. This package turns them into a
+long-lived server: an open-loop load generator (arrivals.py — Poisson at
+a configured offered rate, or a replayable arrival-trace file) feeds an
+arrival-timed admission queue; the serving loop (server.py) forms prefill
+batches from live arrivals, caps prefill/step interleaving with a
+per-dispatch prefill budget, sheds on backpressure (bounded queue,
+per-request deadlines — rejection recorded, never a hang), and meters
+per-request TTFT and end-to-end latency for the p50/p99 bench
+(scripts/serve_bench.py -> docs/SERVE_BENCH_r01.jsonl).
+"""
+
+from fira_tpu.serve.arrivals import (poisson_times, read_trace,  # noqa: F401
+                                     write_trace)
+from fira_tpu.serve.server import (RequestRecord, ServeStats,  # noqa: F401
+                                   serve_errors, serve_split)

@@ -1,0 +1,582 @@
+"""Arrival-timed serving loop over the slot engine (docs/SERVING.md).
+
+The drain drivers (decode/runner.py, parallel/fleet.py) hand the engine a
+pre-packed corpus stream and measure commits/s on the drained batch. This
+module is the ROADMAP-item-1 other half: a long-lived SERVER under
+open-loop load, where requests arrive over time (serve/arrivals.py), the
+scheduler refills slots from live arrivals, and the interesting numbers
+are p50/p99 TTFT and end-to-end latency against offered rate — the
+Orca/vLLM serving regime, not the batch-job regime.
+
+One scheduler round (``ServeLoop._round``), round-robined over the
+engine replicas exactly like parallel/fleet.py:
+
+1. **poll arrivals** — every request whose arrival time has passed moves
+   into the admission queue (bounded by ``cfg.serve_queue_cap``; an
+   arrival that finds it full is SHED immediately — rejection recorded,
+   never a hang). Request payloads are pre-assembled ahead of time by the
+   async Feeder (one single-row ``make_batch`` task per request, split
+   order), so admission never blocks on host assembly.
+2. **shed deadlines** — queued requests older than
+   ``cfg.serve_deadline_steps`` step dispatches are shed (a request that
+   exhausted its whole deadline without being seated cannot answer in
+   time; seated requests always run to harvest and late completions are
+   flagged, not killed).
+3. **admit** — up to ``cfg.serve_prefill_budget`` prefill dispatches PER
+   REPLICA: the head-of-queue request's bucket is flushed into one packed
+   batch (up to ``test_batch_size`` same-bucket requests in arrival
+   order, padded with invalid rows) and prefilled on the claiming
+   replica. The budget is the latency-aware refill knob: every prefill
+   dispatched here stalls the seated slots' next decode step, so a small
+   budget bounds the stall seated requests pay per new admission and a
+   large one trades their tail latency for admission throughput.
+4. **refill / step / harvest** — the engine's own steppable pieces,
+   unchanged: every live replica's step is dispatched before any harvest
+   readback; harvested samples are cooked/written through the same
+   position-keyed ordered writer as drain mode.
+
+Equivalence contract (tests/test_serve.py): on a REPLAYED arrival trace
+with no shedding, output file bytes are IDENTICAL to drain-mode decode —
+per-sample beam math is batch-composition-invariant (every batched op is
+row-wise; the contract decode/engine.py's bit-exactness tests pin), and
+the writer keys by split position — and invariant to replica count,
+harvest cadence, and feeder worker count, with zero post-warmup retraces
+under the same declared (geometry x {prefill, step, insert, harvest})
+program family: serve-mode batches reuse the drain packer's exact
+geometries and batch size, so no new program ever compiles.
+
+Clocks: ``wall`` (the bench — arrivals are paced in real time and idle
+waits sleep) or ``virtual`` (replay — time advances by a fixed cost per
+prefill/step dispatch and jumps across idle gaps), both observing
+latencies only at dispatch/harvest boundaries, which is what the host
+can honestly see.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from fira_tpu.config import FiraConfig
+from fira_tpu.data import buckets as buckets_lib
+from fira_tpu.data.dataset import FiraDataset
+from fira_tpu.data.feeder import Feeder
+from fira_tpu.decode import paging
+from fira_tpu.decode.engine import SlotEngine
+from fira_tpu.decode.runner import output_name, sample_emitter
+from fira_tpu.decode.stream import OrderedStreamWriter
+from fira_tpu.model.model import FiraModel
+
+
+# --------------------------------------------------------------------------
+# parse-time knob validation (CLI exit 2 — the serving twin of
+# parallel.mesh.divisibility_errors / decode.paging.paging_errors)
+# --------------------------------------------------------------------------
+
+def serve_errors(cfg: FiraConfig, *, trace: bool = False) -> List[str]:
+    """Named-knob serving admission check. ``trace``: an arrival-trace
+    file was given (the offered-rate knob is then unused)."""
+    errs: List[str] = []
+    if cfg.serve_rate < 0:
+        errs.append(f"serve_rate {cfg.serve_rate} must be >= 0 requests/s")
+    elif not trace and cfg.serve_rate == 0:
+        errs.append(
+            "serve_rate must be > 0 requests/s when no arrival trace is "
+            "given (the open-loop Poisson generator needs an offered rate)")
+    slots, _reps = paging.resolved_slots(cfg)
+    if not 1 <= cfg.serve_prefill_budget <= slots:
+        errs.append(
+            f"serve_prefill_budget {cfg.serve_prefill_budget} must be >= 1 "
+            f"and <= the per-replica engine slots ({slots}): it caps "
+            f"prefill dispatches interleaved between step dispatches, and "
+            f"a budget past the slot count can never seat more rows")
+    if cfg.serve_deadline_steps < 0:
+        errs.append(
+            f"serve_deadline_steps {cfg.serve_deadline_steps} must be 0 "
+            f"(no deadline) or >= 1: a request cannot complete in less "
+            f"than one step dispatch")
+    if cfg.serve_queue_cap < 0:
+        errs.append(
+            f"serve_queue_cap {cfg.serve_queue_cap} must be 0 (unbounded) "
+            f"or >= 1 queued request")
+    return errs
+
+
+# --------------------------------------------------------------------------
+# clocks
+# --------------------------------------------------------------------------
+
+class VirtualClock:
+    """Deterministic replay clock: a fixed cost per prefill/step dispatch,
+    idle gaps jumped. Makes a replayed trace's scheduling — hence its
+    latency records — a pure function of the trace and the knobs."""
+
+    def __init__(self, *, step_cost_s: float = 1.0,
+                 prefill_cost_s: float = 1.0):
+        self.step_cost_s = float(step_cost_s)
+        self.prefill_cost_s = float(prefill_cost_s)
+        self._now = 0.0
+
+    def now(self) -> float:
+        return self._now
+
+    def advance_to(self, t: float) -> None:
+        self._now = max(self._now, float(t))
+
+    def on_prefill(self) -> None:
+        self._now += self.prefill_cost_s
+
+    def on_step(self) -> None:
+        self._now += self.step_cost_s
+
+
+class WallClock:
+    """Real time: arrivals are paced against the monotonic clock and an
+    idle server sleeps until the next scheduled arrival (open loop — the
+    generator never waits for the server, only the server for it)."""
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+
+    def now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def advance_to(self, t: float) -> None:
+        dt = float(t) - self.now()
+        if dt > 0:
+            time.sleep(dt)
+
+    def on_prefill(self) -> None:
+        pass
+
+    def on_step(self) -> None:
+        pass
+
+
+# --------------------------------------------------------------------------
+# per-request metering
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RequestRecord:
+    """One request's lifecycle timestamps (clock units — wall seconds or
+    virtual units; every stamp is observed at a dispatch/harvest boundary,
+    the only place the host honestly sees device progress)."""
+
+    position: int            # split-local sample position
+    arrival_t: float         # scheduled (open-loop) arrival time
+    status: str = "pending"  # queued|staged|seated|done|shed_queue_full|
+                             # shed_deadline
+    arrival_round: int = -1  # step-dispatch counter at arrival (deadline base)
+    admit_t: float = math.nan       # prefill dispatched (chunk staged)
+    seat_t: float = math.nan        # inserted into a slot
+    first_step_t: float = math.nan  # end of its first step dispatch's
+                                    # harvest phase — the TTFT stamp
+    done_t: float = math.nan        # harvested (all beams settled)
+    done_round: int = -1
+    deadline_missed: bool = False   # completed, but past its deadline
+
+    @property
+    def queue_wait_s(self) -> float:
+        return self.seat_t - self.arrival_t
+
+    @property
+    def ttft_s(self) -> float:
+        return self.first_step_t - self.arrival_t
+
+    @property
+    def e2e_s(self) -> float:
+        return self.done_t - self.arrival_t
+
+
+def _pct(values: List[float], q: float) -> Optional[float]:
+    return round(float(np.percentile(np.asarray(values), q)), 6) \
+        if values else None
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """Aggregate serving accounting: per-request records plus the
+    scheduler counters the knee curve and the A/B rows read."""
+
+    records: List[RequestRecord]
+    completions: List[int] = dataclasses.field(default_factory=list)
+    rounds: int = 0
+    admits: int = 0                 # prefill batches formed from arrivals
+    max_admits_per_round: int = 0   # <= serve_prefill_budget x replicas
+    peak_queue_depth: int = 0
+    shed_queue_full: int = 0
+    shed_deadline: int = 0
+
+    def summary(self) -> Dict:
+        done = [r for r in self.records if r.status == "done"]
+        ttft = [r.ttft_s for r in done if not math.isnan(r.first_step_t)]
+        e2e = [r.e2e_s for r in done]
+        qw = [r.queue_wait_s for r in done]
+        last_done = max((r.done_t for r in done), default=0.0)
+        last_arr = max((r.arrival_t for r in self.records), default=0.0)
+        n = len(self.records)
+        return {
+            "offered": n,
+            "completed": len(done),
+            "shed_queue_full": self.shed_queue_full,
+            "shed_deadline": self.shed_deadline,
+            "deadline_missed": sum(r.deadline_missed for r in done),
+            "rounds": self.rounds,
+            "admits": self.admits,
+            "max_admits_per_round": self.max_admits_per_round,
+            "peak_queue_depth": self.peak_queue_depth,
+            "offered_rate_rps": round(n / last_arr, 4) if last_arr else None,
+            "makespan_s": round(last_done, 6),
+            "throughput_rps": round(len(done) / last_done, 4)
+            if last_done else None,
+            "p50_ttft_s": _pct(ttft, 50), "p99_ttft_s": _pct(ttft, 99),
+            "p50_e2e_s": _pct(e2e, 50), "p99_e2e_s": _pct(e2e, 99),
+            "mean_e2e_s": round(float(np.mean(e2e)), 6) if e2e else None,
+            "p50_queue_wait_s": _pct(qw, 50), "p99_queue_wait_s": _pct(qw, 99),
+        }
+
+
+@dataclasses.dataclass
+class _Queued:
+    record: RequestRecord
+    host: Dict      # the request's single-row assembled batch
+    bucket: int     # decode-table index (0 when unbucketed)
+
+
+# --------------------------------------------------------------------------
+# the serving loop
+# --------------------------------------------------------------------------
+
+class ServeLoop:
+    """Drives N engine replicas under arrival-timed admission. ``emit`` /
+    ``shed`` are callbacks into the output layer (the driver below wires
+    them to the ordered writer)."""
+
+    def __init__(self, engines: Sequence[SlotEngine], cfg: FiraConfig, *,
+                 arrival_times: np.ndarray, feed, table, assignment,
+                 templates: Dict[int, Dict], clock, emit, shed,
+                 refill_order: str = "fifo"):
+        self.engines = list(engines)
+        self.cfg = cfg
+        self.clock = clock
+        self.emit = emit
+        self.shed_cb = shed
+        self.refill_order = refill_order
+        self._table = table
+        self._assignment = assignment
+        self._templates = templates
+        self._bs = int(cfg.test_batch_size)
+        self._budget = max(1, int(cfg.serve_prefill_budget))
+        self._deadline = max(0, int(cfg.serve_deadline_steps))
+        self._cap = max(0, int(cfg.serve_queue_cap))
+        self._times = np.asarray(arrival_times, dtype=np.float64)
+        self._feed_iter = iter(feed)
+        self._arr_idx = 0
+        self._rr = 0   # admission round-robin start (load balance)
+        self._queue: "collections.deque[_Queued]" = collections.deque()
+        self._awaiting_first_step: List[RequestRecord] = []
+        self._final = 0
+        self.stats = ServeStats(records=[
+            RequestRecord(position=i, arrival_t=float(t))
+            for i, t in enumerate(self._times)])
+
+    # --- pieces ---------------------------------------------------------
+
+    def _poll_arrivals(self, now: float) -> None:
+        """Move every due request into the admission queue; an arrival
+        that finds the bounded queue full is shed on the spot."""
+        while self._arr_idx < len(self._times) \
+                and self._times[self._arr_idx] <= now:
+            item = next(self._feed_iter)   # pre-assembled, split order
+            i = self._arr_idx
+            rec = self.stats.records[i]
+            rec.arrival_round = self.stats.rounds
+            if self._cap and len(self._queue) >= self._cap:
+                self._shed(rec, "shed_queue_full")
+            else:
+                rec.status = "queued"
+                bucket = (int(self._assignment[i])  # firacheck: allow[HOST-SYNC] host numpy bucket-assignment array (data/buckets.assign_buckets) — admission runs on host index data only, never device values
+                          if self._assignment is not None else 0)
+                self._queue.append(_Queued(rec, item.host, bucket))
+            self._arr_idx += 1
+        self.stats.peak_queue_depth = max(self.stats.peak_queue_depth,
+                                          len(self._queue))
+
+    def _shed(self, rec: RequestRecord, status: str) -> None:
+        rec.status = status
+        if status == "shed_queue_full":
+            self.stats.shed_queue_full += 1
+        else:
+            self.stats.shed_deadline += 1
+        self._final += 1
+        self.shed_cb(rec)
+
+    def _shed_deadlines(self) -> None:
+        """Drop queued requests whose whole deadline elapsed un-seated."""
+        if not self._deadline:
+            return
+        keep: "collections.deque[_Queued]" = collections.deque()
+        for e in self._queue:
+            if self.stats.rounds - e.record.arrival_round >= self._deadline:
+                self._shed(e.record, "shed_deadline")
+            else:
+                keep.append(e)
+        self._queue = keep
+
+    def _take_chunk(self):
+        """Up to ``test_batch_size`` same-bucket requests, head-of-queue's
+        bucket, arrival order preserved for taken AND left-behind."""
+        bucket = self._queue[0].bucket
+        take: List[_Queued] = []
+        rest: "collections.deque[_Queued]" = collections.deque()
+        while self._queue and len(take) < self._bs:
+            e = self._queue.popleft()
+            (take if e.bucket == bucket else rest).append(e)
+        rest.extend(self._queue)
+        self._queue = rest
+        return bucket, take
+
+    def _form_batch(self, bucket: int, take: List[_Queued]) -> Dict:
+        """Pack the taken requests' pre-assembled rows into one batch at
+        the bucket's geometry (pad rows from the cached all-pad template —
+        exactly a drain-mode packed batch with serve-chosen membership)."""
+        tmpl = self._templates[bucket]
+        batch = {k: np.array(v) for k, v in tmpl.items()}
+        positions = np.full(self._bs, -1, dtype=np.int64)
+        for j, e in enumerate(take):
+            for k in batch:
+                batch[k][j] = e.host[k][0]
+            positions[j] = e.record.position
+        batch["_positions"] = positions
+        if self._table is not None:
+            batch["_tag"] = buckets_lib.geom_tag(self._table[bucket])
+        return batch
+
+    def _admit(self) -> None:
+        """Budgeted admission, replica round-robin: at most
+        ``serve_prefill_budget`` prefill dispatches per replica between
+        step dispatches. The starting replica ROTATES per round so a
+        lightly loaded fleet spreads admissions instead of feeding
+        replica 0 first every time (which replica serves a request never
+        changes its result — the fleet's output-invariance contract —
+        so rotation is purely a load-balance choice, and a
+        deterministic one)."""
+        admitted = 0
+        order = (self.engines[self._rr:] + self.engines[:self._rr])
+        self._rr = (self._rr + 1) % len(self.engines)
+        for eng in order:
+            n = 0
+            while n < self._budget and self._queue and eng.wants_input():
+                bucket, take = self._take_chunk()
+                eng.admit(self._form_batch(bucket, take), 0)
+                self.clock.on_prefill()
+                t = self.clock.now()
+                for e in take:
+                    e.record.admit_t = t
+                    e.record.status = "staged"
+                n += 1
+            admitted += n
+            eng.refill(self.refill_order)
+        self.stats.admits += admitted
+        self.stats.max_admits_per_round = max(
+            self.stats.max_admits_per_round, admitted)
+        t = self.clock.now()
+        for eng in self.engines:
+            for pid in eng.in_flight_positions():
+                rec = self.stats.records[pid]
+                if math.isnan(rec.seat_t):
+                    rec.seat_t = t
+                    rec.status = "seated"
+                    self._awaiting_first_step.append(rec)
+
+    # --- the loop -------------------------------------------------------
+
+    def run(self) -> ServeStats:
+        n = len(self._times)
+        for eng in self.engines:
+            # fresh host scheduling state per request stream (a no-op on
+            # a just-constructed engine; required when a caller reuses a
+            # warmed engine across serving runs — scripts/serve_bench.py)
+            eng.begin_stream()
+        while self._final < n:
+            self._poll_arrivals(self.clock.now())
+            self._shed_deadlines()
+            self._admit()
+            live = [e for e in self.engines if e.in_flight()]
+            if not live:
+                if self._queue or any(e.staged_rows for e in self.engines):
+                    continue    # seats free up / budget admits next round
+                if self._arr_idx < n:
+                    # idle: jump (virtual) / sleep (wall) to the next
+                    # scheduled arrival — open loop, the generator never
+                    # waits for us, only we for it
+                    self.clock.advance_to(self._times[self._arr_idx])
+                    continue
+                if self._final < n:   # pragma: no cover - loop invariant
+                    raise RuntimeError(
+                        "serve loop stalled with requests unaccounted for")
+                break
+            for eng in live:
+                eng.step_dispatch()
+            self.clock.on_step()
+            self.stats.rounds += 1
+            items = [it for eng in live for it in eng.harvest()]
+            t = self.clock.now()   # post-harvest: the honest observation
+            for rec in self._awaiting_first_step:
+                rec.first_step_t = t
+            self._awaiting_first_step = []
+            for it in items:
+                rec = self.stats.records[it.position]
+                rec.done_t = t
+                rec.done_round = self.stats.rounds
+                rec.status = "done"
+                if self._deadline and (rec.done_round - rec.arrival_round
+                                       > self._deadline):
+                    rec.deadline_missed = True
+                self._final += 1
+                self.stats.completions.append(it.position)
+                self.emit(it.position, it.host, it.row, it.tokens, it.probs)
+        return self.stats
+
+
+# --------------------------------------------------------------------------
+# driver (the serving twin of decode.runner.run_test)
+# --------------------------------------------------------------------------
+
+def _request_tasks(data, cfg: FiraConfig, n: int, table, assignment):
+    """One single-row ``make_batch`` task per request, split order — the
+    async Feeder pre-assembles request payloads ahead of their arrival
+    (an open-loop generator knows its requests up front; arrival TIME, not
+    assembly, is what admission is gated on)."""
+    from fira_tpu.data.batching import make_batch
+
+    for i in range(n):
+        geom = table[int(assignment[i])] if table is not None else None  # firacheck: allow[HOST-SYNC] host numpy bucket-assignment array — task generation is pure host-side planning
+        yield (lambda i=i, geom=geom: make_batch(
+            data, np.asarray([i]), cfg, batch_size=1, geom=geom))  # firacheck: allow[HOST-SYNC] np.asarray of a host int list builds the make_batch index chunk; no device value exists here
+
+
+def serve_split(model: FiraModel, params, dataset: FiraDataset,
+                cfg: Optional[FiraConfig] = None, *,
+                arrival_times: np.ndarray,
+                out_dir: str = "OUTPUT",
+                ablation: Optional[str] = None,
+                var_maps: Optional[List[Dict[str, str]]] = None,
+                split: str = "test",
+                guard=None,
+                engine_slots: Optional[int] = None,
+                refill_order: str = "fifo",
+                clock: str = "wall",
+                step_cost_s: float = 1.0,
+                prefill_cost_s: float = 1.0,
+                engine=None) -> Dict:
+    """Serve the first ``len(arrival_times)`` samples of ``split`` as an
+    open-loop request stream (request ``i`` = split position ``i``,
+    arriving at ``arrival_times[i]``). Writes the same position-ordered
+    output file as drain-mode ``run_test`` (shed requests write an empty
+    line, so the file stays position-complete; with zero sheds the bytes
+    are identical to drain mode) and returns its metrics dict plus
+    ``serve`` (ServeStats.summary), ``engine`` (engine/fleet stats), and
+    ``request_records`` (per-request lifecycle dicts).
+
+    ``engine``: an already-constructed (and ideally already-warmed)
+    SlotEngine or EngineFleet to serve on, instead of building one —
+    the bench reuses one warm engine across swept rates so the latency
+    rows measure serving, not per-run cold compiles. The caller owns
+    its cfg consistency (and stats resets between timed runs); the
+    scheduler state itself is reset per run."""
+    cfg = cfg or dataset.cfg
+    data = dataset.splits[split]
+    vocab = dataset.word_vocab
+    indices = dataset.split_indices[split]
+    times = np.asarray(arrival_times, dtype=np.float64)
+    n_req = len(times)
+    if n_req > len(data):
+        raise ValueError(
+            f"arrival trace has {n_req} requests but split {split!r} holds "
+            f"only {len(data)} samples")
+    errs = serve_errors(cfg, trace=True)
+    if errs:
+        raise ValueError("; ".join(errs))
+    if clock == "wall":
+        clk = WallClock()
+    elif clock == "virtual":
+        clk = VirtualClock(step_cost_s=step_cost_s,
+                           prefill_cost_s=prefill_cost_s)
+    else:
+        raise ValueError(f"clock {clock!r} not in {{'wall', 'virtual'}}")
+
+    if cfg.buckets:
+        table = buckets_lib.decode_table(cfg)
+        ext = buckets_lib.sample_extents(data, cfg)
+        assignment = buckets_lib.assign_buckets(
+            ext, table, use_msg=cfg.decode_tar_buckets)
+    else:
+        table = assignment = None
+
+    bs = int(cfg.test_batch_size)
+    if engine is not None:
+        owner = engine
+        engines = getattr(owner, "engines", None) or [owner]
+    else:
+        n_rep = max(1, int(cfg.engine_replicas))
+        if n_rep > 1:
+            from fira_tpu.parallel import fleet as fleet_lib
+
+            owner = fleet_lib.EngineFleet(model, params, cfg,
+                                          replicas=n_rep,
+                                          slots=engine_slots, guard=guard)
+            engines = owner.engines
+        else:
+            owner = SlotEngine(model, params, cfg, slots=engine_slots,
+                               guard=guard)
+            engines = [owner]
+    if table is not None:
+        if engine is None:
+            if guard is not None:
+                guard.declare(owner.labels(table))
+            owner.prewarm((buckets_lib.warmup_batch(data, cfg, g, bs),
+                           buckets_lib.geom_tag(g)) for g in table)
+        templates = {b: buckets_lib.warmup_batch(data, cfg, g, bs)
+                     for b, g in enumerate(table)}
+    else:
+        from fira_tpu.data.batching import make_batch
+
+        templates = {0: make_batch(data, np.arange(0), cfg, batch_size=bs)}
+
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, output_name(ablation))
+    bleu_by_pos: Dict[int, float] = {}
+    with OrderedStreamWriter(out_path, expected=n_req) as writer, \
+            Feeder(_request_tasks(data, cfg, n_req, table, assignment),
+                   num_workers=cfg.feeder_workers, depth=cfg.feeder_depth,
+                   put=False) as feed:
+        emit = sample_emitter(writer, vocab=vocab, cfg=cfg,
+                              bleu_by_pos=bleu_by_pos, n_total=n_req,
+                              var_maps=var_maps, indices=indices)
+        loop = ServeLoop(
+            engines, cfg, arrival_times=times, feed=feed, table=table,
+            assignment=assignment, templates=templates, clock=clk,
+            emit=emit,
+            # a shed request still owns its output position: an empty
+            # line keeps the file position-complete and deterministic
+            shed=lambda rec: writer.add(rec.position, "\n"),
+            refill_order=refill_order)
+        stats = loop.run()
+    n_done = len(bleu_by_pos)
+    total_bleu = sum(bleu_by_pos[p] for p in sorted(bleu_by_pos))
+    return {
+        "sentence_bleu": total_bleu / max(n_done, 1),
+        "n": float(n_done),
+        "output_path": out_path,
+        "serve": stats.summary(),
+        "engine": owner.stats.summary(),
+        "request_records": [dataclasses.asdict(r) for r in stats.records],
+    }

@@ -10,18 +10,20 @@ cd "$(dirname "$0")/.."
 echo "== firacheck: static JAX-hazard scan =="
 # fira_tpu/data/feeder.py, fira_tpu/data/buckets.py,
 # fira_tpu/data/grouping.py, fira_tpu/decode/engine.py,
-# fira_tpu/decode/paging.py and fira_tpu/parallel/fleet.py are named
-# explicitly (as well as being inside the fira_tpu tree, which the CLI
-# dedupes): the async input pipeline, the bucket packer, the grouped
-# dispatch scheduler, the slot-refill decode engine, the paged-KV
-# arena geometry/validation and the replicated decode fleet are
-# designated driver modules (astutil._DRIVER_FILES) whose
-# threaded/packing/refill loops MUST stay in the self-scan even if the
-# directory arguments ever change.
+# fira_tpu/decode/paging.py, fira_tpu/parallel/fleet.py and
+# fira_tpu/serve/server.py are named explicitly (as well as being inside
+# the fira_tpu tree, which the CLI dedupes): the async input pipeline,
+# the bucket packer, the grouped dispatch scheduler, the slot-refill
+# decode engine, the paged-KV arena geometry/validation, the replicated
+# decode fleet and the arrival-timed serving loop are designated driver
+# modules (astutil._DRIVER_FILES) whose threaded/packing/refill/admission
+# loops MUST stay in the self-scan even if the directory arguments ever
+# change.
 JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu fira_tpu/data/feeder.py fira_tpu/data/buckets.py \
     fira_tpu/data/grouping.py fira_tpu/decode/engine.py \
-    fira_tpu/decode/paging.py fira_tpu/parallel/fleet.py tests scripts \
+    fira_tpu/decode/paging.py fira_tpu/parallel/fleet.py \
+    fira_tpu/serve/server.py tests scripts \
     || exit $?
 
 echo "== multichip smoke: 2 virtual CPU devices (docs/MULTICHIP.md) =="
@@ -29,6 +31,12 @@ echo "== multichip smoke: 2 virtual CPU devices (docs/MULTICHIP.md) =="
 # a 2-replica engine-fleet drain under the compile guard, on 2 logical
 # CPU devices (XLA_FLAGS pinned inside the script before jax init).
 JAX_PLATFORMS=cpu python scripts/multichip_bench.py --smoke || exit $?
+
+echo "== serve smoke: fixed-trace replay under the compile guard (docs/SERVING.md) =="
+# The serving loop stays green in tier-1: one fixed-trace virtual-clock
+# replay through the slot engine under the armed compile guard — output
+# bytes must equal drain mode and zero post-warmup compiles must hold.
+JAX_PLATFORMS=cpu python scripts/serve_bench.py --smoke || exit $?
 
 echo "== tier-1 pytest (ROADMAP.md verify, verbatim) =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

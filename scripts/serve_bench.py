@@ -1,0 +1,307 @@
+"""Open-loop serving bench on the slot engine -> docs/SERVE_BENCH_r01.jsonl.
+
+The drain benches (tpu_decode_bench.py, bench.py's decode-engine leg)
+measure commits/s on a pre-packed stream the engine empties as fast as it
+can — a throughput number with no latency story. This bench runs the
+serving loop (fira_tpu/serve — docs/SERVING.md) the way serving systems
+are actually evaluated (Orca OSDI'22 §6, vLLM SOSP'23 §6): an OPEN-loop
+Poisson arrival schedule at a swept offered rate, wall-clock latency
+per request, p50/p99 TTFT and end-to-end reported per rate. Because the
+generator never waits for the server, rates past capacity make the
+admission queue grow without bound and the tail latencies record it —
+the SATURATION KNEE the drain bench cannot see.
+
+Legs (every row is one JSON line in the record):
+
+- ``rate_sweep`` — offered rates as fractions of the measured drain
+  capacity (same engine, same stream, closed loop): below the knee
+  throughput tracks offered rate and p99 e2e stays near service time;
+  past it throughput pins at capacity and p99 grows with the run length.
+- ``prefill_budget_ab`` — the latency-aware refill A/B, below and above
+  the serve knee: ``serve_prefill_budget`` 1 (one prefill between step
+  dispatches — seated requests pay at most one admission stall per
+  step) vs a deep budget (admission throughput first). Below the knee
+  the deep budget's per-admission stall shows up in the tail; at
+  saturation its higher occupancy shows up as throughput — the two
+  halves of the trade the knob exists for.
+
+Absolute numbers are CPU proxies at the fira-tiny geometry (quiet-machine
+caveats in docs/PERF.md apply); the SHAPE — knee location in units of
+drain capacity, budget trade direction — is the artifact.
+
+Modes:
+  (default)   sweep + A/B, write --out (docs/SERVE_BENCH_r01.jsonl),
+              echo a final JSON summary line.
+  --smoke     fixed-trace virtual-clock replay under the armed compile
+              guard for scripts/check.sh: serve-mode output bytes must
+              equal drain mode's and the declared engine program family
+              must show zero post-warmup compiles. Exit nonzero on any
+              violation.
+
+Env knobs: FIRA_SERVE_COMMITS (synthetic corpus size, default 600),
+FIRA_SERVE_RATE_FRACS (default "0.25,0.5,0.8,1.2,1.6" x drain capacity),
+FIRA_SERVE_AB_FRACS (default "0.4,0.9" — below and above the serve
+knee), FIRA_SERVE_SLOTS (default 16),
+FIRA_SERVE_BATCH (default 8), FIRA_SERVE_EOS_DELTA (default 4.0 — the
+mixed-settle bias of the engine benches), FIRA_SERVE_SEED (default 7).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+DEFAULT_OUT = os.path.join(REPO_ROOT, "docs", "SERVE_BENCH_r01.jsonl")
+
+
+def _setup(n_commits: int, *, batch: int, slots: int, eos_delta: float,
+           buckets=()):
+    """Synthetic corpus + tiny engine config + EOS-biased params (mixed
+    settle depths — the schedule the refill loop exists for)."""
+    import numpy as np
+
+    from fira_tpu.config import fira_tiny
+    from fira_tpu.data.batching import make_batch
+    from fira_tpu.data.dataset import FiraDataset
+    from fira_tpu.data.synthetic import write_corpus_dir
+    from fira_tpu.decode.beam import eos_biased_params
+    from fira_tpu.model.model import FiraModel
+    from fira_tpu.train.state import init_state
+
+    data_dir = tempfile.mkdtemp(prefix="fira_serve_bench_")
+    write_corpus_dir(data_dir, n_commits=n_commits, seed=13)
+    cfg = fira_tiny(batch_size=8, test_batch_size=batch,
+                    decode_engine=True, engine_slots=slots,
+                    buckets=buckets)
+    dataset = FiraDataset(data_dir, cfg)
+    cfg = dataset.cfg
+    split = dataset.splits["train"]  # the big synthetic split
+    sample = make_batch(split, np.arange(min(batch, len(split))), cfg,
+                        batch_size=batch)
+    model = FiraModel(cfg)
+    params = eos_biased_params(init_state(model, cfg, sample).params,
+                               delta=eos_delta)
+    return dataset, cfg, model, params
+
+
+def _serve_row(model, params, dataset, cfg, times, out_dir, **kw):
+    from fira_tpu.serve import serve_split
+
+    t0 = time.perf_counter()
+    metrics = serve_split(model, params, dataset, cfg, arrival_times=times,
+                          out_dir=out_dir, split="train", **kw)
+    sv = metrics["serve"]
+    sv["wall_s"] = round(time.perf_counter() - t0, 3)
+    sv["slot_occupancy"] = metrics["engine"]["slot_occupancy"]
+    sv["harvest_bytes_saved"] = metrics["engine"]["harvest_bytes_saved"]
+    return sv, metrics
+
+
+def measure(out_path: str) -> int:
+    from fira_tpu.data.feeder import Feeder
+    from fira_tpu.decode import engine as engine_lib
+    from fira_tpu.decode.runner import _decode_tasks
+    from fira_tpu.serve import poisson_times
+
+    n_commits = int(os.environ.get("FIRA_SERVE_COMMITS", "600"))
+    batch = int(os.environ.get("FIRA_SERVE_BATCH", "8"))
+    slots = int(os.environ.get("FIRA_SERVE_SLOTS", "16"))
+    eos_delta = float(os.environ.get("FIRA_SERVE_EOS_DELTA", "4.0"))
+    seed = int(os.environ.get("FIRA_SERVE_SEED", "7"))
+    fracs = [float(f) for f in os.environ.get(
+        "FIRA_SERVE_RATE_FRACS", "0.25,0.5,0.8,1.2,1.6").split(",")]
+    ab_fracs = [float(f) for f in os.environ.get(
+        "FIRA_SERVE_AB_FRACS", "0.4,0.9").split(",")]
+
+    dataset, cfg, model, params = _setup(
+        n_commits, batch=batch, slots=slots, eos_delta=eos_delta)
+    data = dataset.splits["train"]
+    n = len(data)
+    work = tempfile.mkdtemp(prefix="fira_serve_out_")
+
+    # --- drain capacity: the closed-loop ceiling the sweep is scaled
+    # by. Warm drain first (SlotEngine jits per INSTANCE, so the warm
+    # pass must run on the SAME engine the timed pass uses — the
+    # tpu_decode_bench warm-then-measure discipline), stats reset, then
+    # a timed second drain of the same stream.
+    from fira_tpu.decode import engine as engine_lib
+
+    eng = engine_lib.SlotEngine(model, params, cfg)
+
+    def drain_once():
+        tasks, _ = _decode_tasks(data, cfg)
+        with Feeder(tasks, num_workers=cfg.feeder_workers,
+                    depth=cfg.feeder_depth) as feed:
+            for _ in eng.run(feed):
+                pass
+
+    drain_once()                     # compiles prefill/step/insert/harvest
+    eng.stats = engine_lib.EngineStats(slots=eng.slots)
+    t0 = time.perf_counter()
+    drain_once()
+    drain_s = time.perf_counter() - t0
+    drain_rps = eng.stats.commits / drain_s
+    rows = [{
+        "mode": "drain_capacity", "commits": eng.stats.commits,
+        "wall_s": round(drain_s, 3), "drain_rps": round(drain_rps, 3),
+        "slots": slots, "batch": batch, "n_requests": n,
+        "eos_delta": eos_delta, "seed": seed,
+        "host": "cpu-tiny (fira_tiny geometry; shapes are the artifact, "
+                "not absolute numbers)",
+    }]
+
+    # One untimed serve warm pass (short stream at the drain rate):
+    # first-use costs off the timed rows — text-cooking/BLEU imports and
+    # the serve path's own first touches cost ~seconds on first use,
+    # which would otherwise land entirely in the first swept rate's
+    # latency percentiles (measured: a 2.5 s first-run stall regardless
+    # of which rate runs first).
+    _serve_row(model, params, dataset, cfg,
+               poisson_times(min(n, 4 * batch), drain_rps, seed=seed),
+               os.path.join(work, "warm"), engine=eng)
+
+    # --- rate sweep: offered rate as a fraction of drain capacity. The
+    # WARM engine is reused across runs (serve_split ``engine=``) with a
+    # stats reset per run, so the latency rows measure serving — not the
+    # per-run cold compiles a fresh engine would pay while the whole
+    # arrival schedule piles into the queue.
+    for frac in fracs:
+        rate = frac * drain_rps
+        times = poisson_times(n, rate, seed=seed)
+        eng.stats = engine_lib.EngineStats(slots=eng.slots)
+        sv, _ = _serve_row(model, params, dataset, cfg, times,
+                           os.path.join(work, f"r{frac}"), engine=eng)
+        rows.append({"mode": "rate_sweep", "rate_frac": round(frac, 3),
+                     "offered_rps": round(rate, 3), **sv})
+
+    # --- prefill-budget A/B, below AND above the serve knee: budget 1
+    # (bounded per-step admission stall) vs a deep budget (admission
+    # throughput). Below the knee the seated requests' per-admission
+    # stall is the visible cost of a deep budget; at saturation the
+    # deep budget's higher occupancy is the visible win — both halves
+    # of the trade the knob exists for. The deep-budget run needs a
+    # deeper staging policy (wants_input clips at engine_prefill_depth,
+    # an engine-side knob), so it gets its own engine, warmed by one
+    # untimed drain.
+    deep = max(2, slots // batch * 2)
+    engines = {1: eng}
+    for budget in (deep,):
+        c = cfg.replace(engine_prefill_depth=max(cfg.engine_prefill_depth,
+                                                 budget))
+        ab_eng = engine_lib.SlotEngine(model, params, c)
+        tasks, _ = _decode_tasks(data, c)
+        with Feeder(tasks, num_workers=c.feeder_workers,
+                    depth=c.feeder_depth) as feed:
+            for _ in ab_eng.run(feed):   # untimed warm drain
+                pass
+        engines[budget] = ab_eng
+    for ab_frac in ab_fracs:
+        ab_rate = ab_frac * drain_rps
+        ab_times = poisson_times(n, ab_rate, seed=seed)
+        for budget in (1, deep):
+            c = cfg.replace(serve_prefill_budget=budget,
+                            engine_prefill_depth=max(
+                                cfg.engine_prefill_depth, budget))
+            ab_eng = engines[budget]
+            ab_eng.stats = engine_lib.EngineStats(slots=ab_eng.slots)
+            sv, _ = _serve_row(model, params, dataset, c, ab_times,
+                               os.path.join(work, f"ab{ab_frac}_{budget}"),
+                               engine=ab_eng)
+            rows.append({"mode": "prefill_budget_ab",
+                         "serve_prefill_budget": budget,
+                         "rate_frac": round(ab_frac, 3),
+                         "offered_rps": round(ab_rate, 3), **sv})
+
+    # --- knee: the largest offered rate the server still answers at ~the
+    # offered rate (completed throughput >= 90% of offered). Past it the
+    # open-loop queue grows without bound and p99 e2e scales with run
+    # length instead of service time.
+    sweep = [r for r in rows if r["mode"] == "rate_sweep"]
+    under = [r for r in sweep
+             if r["throughput_rps"] and r["offered_rps"]
+             and r["throughput_rps"] >= 0.9 * r["offered_rps"]]
+    knee = {
+        "mode": "knee",
+        "drain_rps": round(drain_rps, 3),
+        "knee_offered_rps": max((r["offered_rps"] for r in under),
+                                default=None),
+        "knee_rate_frac": max((r["rate_frac"] for r in under),
+                              default=None),
+        "note": "largest swept offered rate with completed throughput >= "
+                "0.9x offered; p99 e2e above the knee is run-length-bound "
+                "(open-loop queue growth), not service-time-bound",
+    }
+    rows.append(knee)
+
+    stamp = {"generated_by": "scripts/serve_bench.py",
+             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    with open(out_path, "w") as f:
+        f.write(json.dumps(stamp) + "\n")
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    print(json.dumps({"rows": rows, "out": out_path}), flush=True)
+    return 0
+
+
+def smoke() -> int:
+    """Fixed-trace virtual-clock replay under the armed compile guard:
+    serve bytes == drain bytes, zero post-warmup compiles, everything
+    completed. The check.sh tier-1 leg."""
+    from fira_tpu.analysis import sanitizer
+    from fira_tpu.decode.runner import run_test
+    from fira_tpu.serve import poisson_times
+
+    dataset, cfg, model, params = _setup(
+        40, batch=6, slots=6, eos_delta=4.0, buckets=((16, 400, 12),))
+    n = len(dataset.splits["train"])
+    times = poisson_times(n, rate=0.5, seed=3)  # virtual-clock units
+    work = tempfile.mkdtemp(prefix="fira_serve_smoke_")
+
+    drain = run_test(model, params, dataset, cfg,
+                     out_dir=os.path.join(work, "drain"), split="train")
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        served, _ = _serve_row(model, params, dataset, cfg, times,
+                               os.path.join(work, "serve"), guard=guard,
+                               clock="virtual")
+        extra = guard.compiles_after_warmup()
+    ref = open(drain["output_path"], "rb").read()
+    got = open(os.path.join(work, "serve", "output_fira"), "rb").read()
+    ok = (got == ref and extra == 0
+          and served["completed"] == n and served["shed_queue_full"] == 0
+          and served["shed_deadline"] == 0)
+    print(json.dumps({
+        "smoke": "ok" if ok else "FAIL",
+        "bytes_equal_drain": got == ref,
+        "compiles_after_warmup": extra,
+        "completed": served["completed"], "offered": n,
+        "p50_e2e_virtual": served["p50_e2e_s"],
+        "p99_e2e_virtual": served["p99_e2e_s"],
+    }), flush=True)
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="fixed-trace replay sanity leg (scripts/check.sh)")
+    ap.add_argument("--out", default=DEFAULT_OUT,
+                    help=f"JSONL record path (default {DEFAULT_OUT})")
+    args = ap.parse_args()
+
+    from fira_tpu.utils.backend_guard import force_cpu_backend
+
+    force_cpu_backend()
+    if args.smoke:
+        return smoke()
+    return measure(args.out)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
