@@ -137,6 +137,12 @@ prefill-budget A/B over the serving loop, fira_tpu/serve — and folds its
 p50/p99 TTFT / e2e latency rows and the saturation knee into this
 record; the full artifact lands in docs/SERVE_BENCH_r01.jsonl.
 FIRA_BENCH_SERVE_TIMEOUT caps the sweep, default 900 s),
+FIRA_BENCH_CHAOS=1 (opt-in chaos leg: runs scripts/chaos_bench.py —
+throughput / shed-rate / retirement rows under seeded injected fault
+rates through the serving loop, fira_tpu/robust (docs/FAULTS.md) — and
+folds its rows into this record; the full artifact lands in
+docs/CHAOS_BENCH_r01.jsonl. FIRA_BENCH_CHAOS_TIMEOUT caps the sweep,
+default 900 s),
 
 Composed leg — the production path going forward (ISSUE 4): the stacked
 knobs AND the auto bucket table together. One shuffled epoch plan of
@@ -783,33 +789,49 @@ def worker() -> None:
             print(f"multichip leg failed: {e!r}", file=sys.stderr)
             multichip = {"error": repr(e)}
 
+    def _script_rows_leg(name, script_name, timeout_env):
+        """Shared shape of the opt-in subprocess legs whose scripts emit
+        one final JSON line with a ``rows`` list (serve_bench.py,
+        chaos_bench.py): run it with a bounded timeout, fold the rows,
+        degrade failures to a structured error field — never sinking the
+        main measurement."""
+        try:
+            script = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "scripts", script_name)
+            p = subprocess.run(
+                [sys.executable, script], text=True,
+                timeout=float(os.environ.get(timeout_env, "900")),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            rec = _last_json_line(p.stdout or "")
+            if p.returncode == 0 and rec and rec.get("rows"):
+                return {"rows": rec["rows"]}
+            return {"error": f"rc={p.returncode}",
+                    "tail": (p.stderr or p.stdout or "")[-300:]}
+        except Exception as e:
+            print(f"{name} leg failed: {e!r}", file=sys.stderr)
+            return {"error": repr(e)}
+
     # (g) SERVE leg (opt-in: FIRA_BENCH_SERVE=1): the online-serving
     # latency story — scripts/serve_bench.py sweeps open-loop Poisson
     # offered rates over the serving loop (fira_tpu/serve) and emits
     # p50/p99 TTFT + e2e latency per rate, the saturation knee, and the
-    # prefill-budget A/B. One subprocess (it owns its synthetic corpus
-    # and forces the CPU backend); failures degrade to a structured
-    # error field, never sinking the main measurement.
+    # prefill-budget A/B. The subprocess owns its synthetic corpus and
+    # forces the CPU backend.
     serve = None
     if os.environ.get("FIRA_BENCH_SERVE", "0") == "1":
-        try:
-            script = os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                "scripts", "serve_bench.py")
-            p = subprocess.run(
-                [sys.executable, script], text=True,
-                timeout=float(os.environ.get(
-                    "FIRA_BENCH_SERVE_TIMEOUT", "900")),
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-            rec = _last_json_line(p.stdout or "")
-            if p.returncode == 0 and rec and rec.get("rows"):
-                serve = {"rows": rec["rows"]}
-            else:
-                serve = {"error": f"rc={p.returncode}",
-                         "tail": (p.stderr or p.stdout or "")[-300:]}
-        except Exception as e:
-            print(f"serve leg failed: {e!r}", file=sys.stderr)
-            serve = {"error": repr(e)}
+        serve = _script_rows_leg("serve", "serve_bench.py",
+                                 "FIRA_BENCH_SERVE_TIMEOUT")
+
+    # (h) CHAOS leg (opt-in: FIRA_BENCH_CHAOS=1): graceful degradation
+    # under injected fault rates — scripts/chaos_bench.py serves the same
+    # open-loop stream with seeded faults armed at increasing rates and
+    # records throughput, shed rate, retirements, and requeues per rate
+    # (fira_tpu/robust; docs/FAULTS.md).
+    chaos = None
+    if os.environ.get("FIRA_BENCH_CHAOS", "0") == "1":
+        chaos = _script_rows_leg("chaos", "chaos_bench.py",
+                                 "FIRA_BENCH_CHAOS_TIMEOUT")
 
     step_time = dt_e2e / steps_per_window
     compute_step_time = dt_compute / steps_per_window
@@ -865,6 +887,9 @@ def worker() -> None:
         # online-serving latency rows (FIRA_BENCH_SERVE=1; the full
         # artifact is docs/SERVE_BENCH_r01.jsonl — scripts/serve_bench.py)
         **({"serve": serve} if serve else {}),
+        # chaos / graceful-degradation rows (FIRA_BENCH_CHAOS=1; the full
+        # artifact is docs/CHAOS_BENCH_r01.jsonl — scripts/chaos_bench.py)
+        **({"chaos": chaos} if chaos else {}),
         "feed_stall_frac_sync_assembly": sync_info["feed_stall_frac"],
         "value_e2e_sync_assembly": round(
             batch_size / (dt_sync / steps_per_window) / n_chips, 2),

@@ -184,6 +184,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "time — the latency-measurement mode; 'virtual' "
                         "advances a deterministic unit clock per dispatch "
                         "— the replayable-trace equivalence mode")
+    p.add_argument("--inject-faults", default=None, metavar="SPEC",
+                   help="seeded fault injection (docs/FAULTS.md): "
+                        "'site:kind:rate:seed[,...]' arming named "
+                        "injection points (sites: feeder.assemble, "
+                        "feeder.device_put, engine.prefill, engine.step, "
+                        "engine.harvest, fleet.replica, serve.admit; "
+                        "kinds: raise | hang | corrupt). Deterministic "
+                        "given the seed — chaos runs replay exactly; "
+                        "validated at parse time, exit 2. Off by default "
+                        "(zero hot-path overhead)")
+    p.add_argument("--dispatch-watchdog-s", type=float, default=None,
+                   metavar="S",
+                   help="per-dispatch wall-clock watchdog (docs/FAULTS"
+                        ".md): a fleet/serve replica dispatch exceeding "
+                        "S seconds is abandoned and the replica RETIRED "
+                        "(its requests requeued onto survivors); a dev "
+                        "gate exceeding it is skipped with a recorded "
+                        "warning. 0 = off (default); validated at parse "
+                        "time, exit 2")
+    p.add_argument("--robust-retries", type=int, default=None, metavar="N",
+                   help="poison-request quarantine depth (docs/FAULTS"
+                        ".md): retries (with backoff) a request gets "
+                        "when its assembly/admission/prefill raises, "
+                        "before it is shed with a recorded error and an "
+                        "empty output line (default 1; >= 0, validated "
+                        "at parse time, exit 2)")
     p.add_argument("--beam-log-space", action="store_true",
                    help="log-space beam accumulation instead of the "
                         "reference-compat probability space")
@@ -324,6 +350,12 @@ def _resolve_cfg(args):
         overrides["serve_deadline_steps"] = args.serve_deadline_steps
     if args.serve_queue_cap is not None:
         overrides["serve_queue_cap"] = args.serve_queue_cap
+    if args.inject_faults is not None:
+        overrides["inject_faults"] = args.inject_faults
+    if args.dispatch_watchdog_s is not None:
+        overrides["dispatch_watchdog_s"] = args.dispatch_watchdog_s
+    if args.robust_retries is not None:
+        overrides["robust_retries"] = args.robust_retries
     if args.adjacency:
         overrides["adjacency_impl"] = args.adjacency
     if args.encoder_buffer:
@@ -456,6 +488,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from fira_tpu.serve.server import serve_errors
 
         errs += serve_errors(cfg, trace=args.serve_trace is not None)
+    # robustness knob admission (fault-spec grammar, watchdog timeout,
+    # quarantine retry count) — same exit-2 contract, every command
+    # (the watchdog also guards train's dev gates) —
+    # robust.faults.robust_errors
+    from fira_tpu.robust.faults import robust_errors
+
+    errs += robust_errors(cfg)
     if errs:
         for e in errs:
             print(f"parse-time validation: {e}", file=sys.stderr)
@@ -532,25 +571,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return 2
         else:
             times = poisson_times(n_req, cfg.serve_rate, seed=cfg.seed)
+        # serve_split maintains serve_metrics.json itself: a .partial
+        # snapshot refreshes atomically through the run (a kill leaves a
+        # recent valid-JSON artifact) and the final file is written
+        # atomically at completion — the ordered writer's crash contract
+        # applied to metrics (docs/FAULTS.md)
+        metrics_path = os.path.join(args.out_dir, "serve_metrics.json")
         metrics = serve_split(model, params, dataset, cfg,
                               arrival_times=times, out_dir=args.out_dir,
                               ablation=args.ablation, var_maps=var_maps,
-                              guard=guard, clock=args.serve_clock)
+                              guard=guard, clock=args.serve_clock,
+                              metrics_path=metrics_path)
         sv = metrics["serve"]
-        metrics_path = os.path.join(args.out_dir, "serve_metrics.json")
-        # shed requests carry NaN lifecycle stamps (they were never
-        # seated); serialize them as null — bare NaN tokens would make
-        # the advertised machine-readable artifact invalid strict JSON
-        records = [{k: (None if isinstance(v, float) and v != v else v)
-                    for k, v in r.items()}
-                   for r in metrics["request_records"]]
-        with open(metrics_path, "w") as f:
-            json.dump({"serve": sv, "engine": metrics["engine"],
-                       "request_records": records},
-                      f, indent=1, allow_nan=False)
         print(f"serve: {sv['completed']}/{sv['offered']} completed "
               f"(shed {sv['shed_queue_full']} queue-full, "
-              f"{sv['shed_deadline']} deadline)  "
+              f"{sv['shed_deadline']} deadline, "
+              f"{sv['shed_error']} error; "
+              f"{sv['replica_retirements']} replica retirements)  "
               f"p50/p99 ttft {sv['p50_ttft_s']}/{sv['p99_ttft_s']} s  "
               f"p50/p99 e2e {sv['p50_e2e_s']}/{sv['p99_e2e_s']} s  "
               f"-> {metrics_path}")

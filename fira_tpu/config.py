@@ -250,6 +250,37 @@ class FiraConfig:
     # output file keeps the position with an empty line). 0 = unbounded.
     serve_queue_cap: int = 0
 
+    # --- robustness / fault injection (robust/; docs/FAULTS.md) ---
+    # Seeded fault-injection spec "site:kind:rate:seed[,...]" arming named
+    # injection points along the request path (sites: feeder.assemble,
+    # feeder.device_put, engine.prefill, engine.step, engine.harvest,
+    # fleet.replica, serve.admit; kinds: raise | hang | corrupt).
+    # Deterministic given the seed — every chaos run replays exactly —
+    # and validated at parse time (robust.faults.robust_errors, CLI
+    # exit 2). "" = off: the injector is None and every site check is one
+    # is-None branch, zero hot-path overhead.
+    inject_faults: str = ""
+    # Per-dispatch wall-clock watchdog in seconds: a fleet/serve replica
+    # dispatch (prefill/step/harvest) that exceeds it is ABANDONED on its
+    # worker thread and the replica retired, its in-flight requests
+    # requeued onto survivors; in train, a dev gate that exceeds it is
+    # skipped with a recorded warning instead of wedging the epoch.
+    # 0 = off (dispatches run inline, zero overhead); must be 0 or > 0
+    # (validated at parse time, exit 2).
+    dispatch_watchdog_s: float = 0.0
+    # Poison-request quarantine depth: how many retries (with backoff) a
+    # request gets when its host-side assembly, admission, or prefill
+    # raises, before it is SHED with a recorded error and an empty output
+    # line (extending the serve shed contract — the feeder's per-task
+    # error channel keeps one bad sample from poisoning the whole feed).
+    # Must be >= 0 (validated at parse time, exit 2).
+    robust_retries: int = 1
+    # Wall seconds an injected "hang" fault sleeps — bounded on purpose,
+    # so an unwatched chaos run stalls and recovers instead of wedging
+    # forever; set it well above dispatch_watchdog_s to exercise
+    # retirement. Must be > 0 (validated at parse time, exit 2).
+    fault_hang_s: float = 2.0
+
     # --- typed edges (beyond-parity extension) ---
     # The reference computes six edge families then flattens them into one
     # untyped adjacency (process_edge's `kind` is dead, Dataset.py:346-357;

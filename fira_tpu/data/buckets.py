@@ -335,6 +335,12 @@ def bucketed_assembly_tasks(split: ProcessedSplit, plan: Plan,
             batch["_positions"] = positions
             batch["_tag"] = geom_tag(geom)
             return batch
+        # a failing worker's FeederTaskError names the poisoned chunk:
+        # split positions + bucket geometry (data/feeder.task_note)
+        from fira_tpu.data.feeder import task_note
+
+        build.note = task_note(chunk, geom_tag=geom_tag(geom),
+                               site="bucketed_assembly_tasks")
         return build
 
     for chunk, geom in plan:

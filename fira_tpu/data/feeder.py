@@ -26,10 +26,23 @@ training loop:
   by ONE task on one worker, so the whole K-group ships as a single
   ``device_put`` instead of K round-trips; ``n_valid`` sums the 2-D
   ``valid`` of a stacked group the same way it sums the 1-D one.
-- **errors**: the first worker/dispatcher exception is re-raised at the
-  consumer on its next ``__next__`` (not deferred until the failing
-  sequence number comes up), so a poisoned pipeline surfaces within one
-  step.
+- **errors**: every task exception is wrapped in :class:`FeederTaskError`
+  carrying the task's sequence number and its ``note`` (split positions,
+  bucket geometry — set by the task generators), so a poisoned sample is
+  identifiable from the traceback. A failing task is retried up to
+  ``retries`` times with linear backoff first (transient faults are
+  absorbed in the worker). Then, under the default ``on_error="raise"``,
+  the first surviving exception re-raises at the consumer on its next
+  ``__next__`` (not deferred until the failing sequence number comes up)
+  — the historical fail-stop contract. Under ``on_error="record"`` (the
+  serving path's PER-TASK ERROR CHANNEL, docs/FAULTS.md) the failing
+  item is emitted in sequence with ``error`` set and ``host``/``device``
+  None, and the stream continues: one bad sample no longer poisons the
+  feed — the consumer sheds it (serve/server.py) instead of dying.
+- **fault injection**: an armed robust.faults.FaultInjector checks the
+  ``feeder.assemble`` / ``feeder.device_put`` sites around each task,
+  keyed by (task sequence, attempt) so thread scheduling cannot reorder
+  the deterministic draws; None (default) costs one is-None branch.
 - **shutdown**: ``close()`` (or the context manager / end-of-stream /
   error paths, which call it) stops dispatch, unblocks and joins every
   thread — no live threads remain (pinned by tests/test_feeder.py).
@@ -62,18 +75,38 @@ Batch = Dict[str, Any]
 Task = Callable[[], Batch]
 
 
+class FeederTaskError(RuntimeError):
+    """One assembly task failed (after its retry budget): carries the
+    task's sequence number and its generator-set ``note`` — split
+    positions, bucket geometry, site — so the poisoned sample is
+    identifiable from the traceback instead of an anonymous re-raise."""
+
+    def __init__(self, index: int, note: Optional[str],
+                 original: BaseException) -> None:
+        where = f" ({note})" if note else ""
+        super().__init__(
+            f"feeder task {index}{where} failed: "
+            f"{type(original).__name__}: {original}")
+        self.index = index
+        self.note = note
+        self.original = original
+
+
 @dataclasses.dataclass
 class FedBatch:
     """One emitted pipeline item."""
 
     index: int          # position in the deterministic batch order
-    host: Batch         # the assembled numpy batch (for host-side fields,
-                        # incl. "_"-prefixed host-only metadata)
+    host: Optional[Batch]  # the assembled numpy batch (for host-side
+                        # fields, incl. "_"-prefixed host-only metadata);
+                        # None on an error-carrying item (record mode)
     device: Any         # jax.device_put result, "_" keys stripped
                         # (== host when put=False)
     n_valid: int        # real (non-pad) rows, computed pre-transfer
     stall_s: float      # consumer time blocked waiting for THIS item
     queue_depth: int    # ready-but-unconsumed items when consumer arrived
+    error: Optional[BaseException] = None  # FeederTaskError in record mode
+    retries: int = 0    # assembly attempts beyond the first this item took
 
 
 class Feeder:
@@ -87,21 +120,34 @@ class Feeder:
     """
 
     def __init__(self, tasks: Iterable[Task], *, num_workers: int = 2,
-                 depth: int = 4, sharding=None, put: bool = True):
+                 depth: int = 4, sharding=None, put: bool = True,
+                 on_error: str = "raise", retries: int = 0,
+                 retry_backoff_s: Optional[float] = None, faults=None):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         if num_workers < 0:
             raise ValueError(f"num_workers must be >= 0, got {num_workers}")
+        if on_error not in ("raise", "record"):
+            raise ValueError(f"on_error {on_error!r} not in "
+                             f"{{'raise', 'record'}}")
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
         self._sharding = sharding
         self._put = put
         self._num_workers = num_workers
         self._depth = depth
+        self._on_error = on_error
+        self._retries = retries
+        self._retry_backoff_s = retry_backoff_s
+        self._faults = faults          # robust.faults.FaultInjector or None
         self._next = 0                 # next sequence number to emit
         self._n_stalls = 0
         self._stall_s = 0.0
         self._stall_max = 0.0
         self._depth_sum = 0
         self._depth_min: Optional[int] = None
+        self._n_task_errors = 0
+        self._n_task_retries = 0
         self._closed = False
 
         if num_workers == 0:
@@ -159,18 +205,55 @@ class Feeder:
                 return
             seq, task = got
             try:
-                host = task()
-                # host-side row count BEFORE the transfer — reading it back
-                # from the device array would force a mid-epoch sync
-                n_valid = int(host["valid"].sum())
-                device = self._device_put(host)
+                item = self._execute(seq, task)
             except BaseException as e:
                 self._poison(e)
                 return
             with self._cond:
-                self._ready[seq] = FedBatch(seq, host, device, n_valid,
-                                            0.0, 0)
+                self._ready[seq] = item
                 self._cond.notify_all()
+
+    def _execute(self, seq: int, task: Task) -> FedBatch:
+        """Run ONE assembly task under the retry/fault policy. Transient
+        failures burn the retry budget with linear backoff; a surviving
+        exception is wrapped with the task's identity (FeederTaskError)
+        and either raised (``on_error="raise"``, the fail-stop default)
+        or returned as an error-carrying item (``"record"`` — the
+        per-task error channel the serving path sheds on)."""
+        attempt = 0
+        while True:
+            try:
+                if self._faults is not None:
+                    self._faults.check("feeder.assemble", key=(seq, attempt))
+                host = task()
+                if self._faults is not None:
+                    host = self._faults.corrupt("feeder.assemble", seq, host)
+                # host-side row count BEFORE the transfer — reading it
+                # back from the device array would force a mid-epoch sync
+                n_valid = int(host["valid"].sum())
+                if self._faults is not None:
+                    self._faults.check("feeder.device_put",
+                                       key=(seq, attempt))
+                device = self._device_put(host)
+                return FedBatch(seq, host, device, n_valid, 0.0, 0,
+                                retries=attempt)
+            except Exception as e:
+                if attempt < self._retries:
+                    attempt += 1
+                    if self._retry_backoff_s is not None:
+                        time.sleep(self._retry_backoff_s * attempt)
+                    else:
+                        # the shared quarantine backoff curve — one
+                        # definition for every retry site (docs/FAULTS.md)
+                        from fira_tpu.robust.faults import backoff_s
+
+                        time.sleep(backoff_s(attempt))
+                    continue
+                err = FeederTaskError(seq, getattr(task, "note", None), e)
+                if self._on_error == "record":
+                    return FedBatch(seq, None, None, 0, 0.0, 0, error=err,
+                                    retries=attempt)
+                raise err from e
 
     def _device_put(self, host: Batch):
         if not self._put:
@@ -224,32 +307,33 @@ class Feeder:
         self._inflight.release()
         item.stall_s = stall
         item.queue_depth = depth_seen
-        self._record(stall, depth_seen)
+        self._record(item, stall, depth_seen)
         return item
 
     def _next_sync(self) -> FedBatch:
         t0 = time.perf_counter()
         try:
             task = next(self._task_iter)
-            host = task()
-            n_valid = int(host["valid"].sum())
-            device = self._device_put(host)
         except StopIteration:
             self._closed = True
             raise
+        item = self._execute(self._next, task)
         stall = time.perf_counter() - t0
-        seq = self._next
         self._next += 1
-        self._record(stall, 0)
-        return FedBatch(seq, host, device, n_valid, stall, 0)
+        item.stall_s = stall
+        self._record(item, stall, 0)
+        return item
 
-    def _record(self, stall: float, depth_seen: int) -> None:
+    def _record(self, item: FedBatch, stall: float, depth_seen: int) -> None:
         self._n_stalls += 1
         self._stall_s += stall
         self._stall_max = max(self._stall_max, stall)
         self._depth_sum += depth_seen
         self._depth_min = (depth_seen if self._depth_min is None
                            else min(self._depth_min, depth_seen))
+        self._n_task_retries += item.retries
+        if item.error is not None:
+            self._n_task_errors += 1
 
     # --- lifecycle ---
 
@@ -300,6 +384,11 @@ class Feeder:
             "queue_depth_min": float(self._depth_min or 0),
             "num_workers": float(self._num_workers),
             "depth": float(self._depth),
+            # per-task error channel accounting (docs/FAULTS.md): items
+            # emitted with a recorded error (record mode) and assembly
+            # retry attempts absorbed in the workers
+            "task_errors": float(self._n_task_errors),
+            "task_retries": float(self._n_task_retries),
         }
 
     # --- adapters ---
@@ -317,12 +406,34 @@ class Feeder:
                    sharding=sharding, put=put)
 
 
+def task_note(positions, *, geom_tag: Optional[str] = None,
+              site: Optional[str] = None) -> str:
+    """Human-readable task identity for FeederTaskError: the split
+    positions the task assembles (truncated), plus the bucket geometry
+    and call site when known — enough to name the poisoned sample from
+    the traceback alone."""
+    pos = [int(p) for p in positions]  # firacheck: allow[HOST-SYNC] positions are host-side planning ints (index chunks / request ids); no device value exists here
+    shown = ", ".join(str(p) for p in pos[:6])
+    if len(pos) > 6:
+        shown += f", ... {len(pos) - 6} more"
+    parts = [f"split positions [{shown}]"]
+    if geom_tag:
+        parts.append(f"bucket {geom_tag}")
+    if site:
+        parts.append(site)
+    return "; ".join(parts)
+
+
 def assembly_tasks(split, chunks, cfg, *, batch_size: Optional[int] = None
                    ) -> Iterator[Task]:
     """One ``make_batch`` task per index chunk (see
-    data.batching.epoch_index_chunks for the order contract)."""
+    data.batching.epoch_index_chunks for the order contract). Each task
+    carries a ``note`` naming its split positions, so a failing worker's
+    FeederTaskError identifies the poisoned chunk."""
     from fira_tpu.data.batching import make_batch
 
     for chunk in chunks:
-        yield (lambda c=chunk: make_batch(split, c, cfg,
-                                          batch_size=batch_size))
+        task = (lambda c=chunk: make_batch(split, c, cfg,
+                                           batch_size=batch_size))
+        task.note = task_note(chunk, site="assembly_tasks")
+        yield task

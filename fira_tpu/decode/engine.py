@@ -241,10 +241,18 @@ class SlotEngine:
     def __init__(self, model: FiraModel, params, cfg: FiraConfig, *,
                  slots: Optional[int] = None, guard=None,
                  device=None, tag: Optional[str] = None,
-                 pool_blocks: Optional[int] = None):
+                 pool_blocks: Optional[int] = None, faults=None):
         self.model = model
         self.params = params
         self.cfg = cfg
+        # robust.faults.FaultInjector (or None — the zero-overhead
+        # default): checks the engine.{prefill,step,harvest} sites at
+        # each dispatch. ``retired`` is set by retire(): a replica whose
+        # dispatch raised or blew the watchdog is dead — every steppable
+        # piece bails early on it, including an abandoned watchdog thread
+        # that wakes up after the retirement (docs/FAULTS.md).
+        self._faults = faults
+        self.retired = False
         self.slots = int(slots or cfg.engine_slots or cfg.test_batch_size)
         if self.slots < 1:
             raise ValueError(f"engine needs >= 1 slot, got {self.slots}")
@@ -645,16 +653,39 @@ class SlotEngine:
 
     def prewarm(self, warm_batches: Iterable[Tuple[Dict, Optional[str]]]
                 ) -> None:
-        """Compile the prefill program family up front: one all-pad batch
-        per decode bucket geometry (the compile keys), tagged with the
-        geometry's guard label. The step/insert programs take their single
-        warmup compile at their natural first dispatch."""
+        """Compile the WHOLE program family up front: one all-pad batch
+        per decode bucket geometry (the prefill compile keys), then one
+        no-op insert (every slot id the drop sentinel), one step over the
+        all-dead arena (no slot active — the state is untouched), and one
+        harvest row gather. Outputs are unchanged by construction (pinned
+        by the byte-equality tests); the point is that NO dispatch after
+        prewarm pays a compile — which the per-dispatch wall-clock
+        watchdog (docs/FAULTS.md) depends on: a first-use XLA compile
+        inside a watchdogged dispatch would read as a hung replica."""
+        chunk = None
         for host, tag in warm_batches:
             wire = {k: v for k, v in host.items() if not k.startswith("_")}
             chunk = self._prefill(self.params,
                                   jax.device_put(wire, self.device))
             self._guard_step(self.label(PREFILL_KIND, tag))
             self._ensure_state(chunk)
+        if chunk is None:
+            return
+        C = int(chunk["diff"].shape[0])
+        sentinel_ids = np.full((C,), self.slots, dtype=np.int32)  # all drop
+        limits = np.full((C,), self.cfg.tar_len, dtype=np.int32)
+        block_rows = (np.full((C, self._table_width), self._pool_blocks,
+                              dtype=np.int32) if self._paged else None)
+        self._state = self._insert(self._state, chunk, sentinel_ids,
+                                   limits, block_rows)
+        self._guard_step(self.label(INSERT_LABEL))
+        self._state, occ = self._step(self.params, self._state)
+        self._guard_step(self.label(STEP_LABEL))
+        if self._pending_occ is None:
+            self._pending_occ = occ  # zero: no slot was active
+        self._take_rows(self._state["tokens"], self._state["probs"],
+                        jnp.int32(0))
+        self._guard_step(self.label(HARVEST_LABEL))
 
     # --- steppable scheduler pieces (the fleet round-robins these) -------
 
@@ -692,6 +723,57 @@ class SlotEngine:
         """Admitted (prefilled) rows not yet seated in a slot."""
         return self._staged_rows
 
+    def pending_positions(self) -> List[int]:
+        """Every admitted-but-unfinished request position: seated in a
+        slot OR staged for refill — exactly the set a retirement must
+        requeue onto surviving replicas."""
+        pos = [pid for (pid, _host, _row) in self._busy.values()]
+        pos += [pid for e in self._staged for (_r, pid) in e.rows]
+        return pos
+
+    def retire(self) -> List[Dict]:
+        """Mark THIS engine dead and hand back re-admission payloads for
+        every request it still owed: one host batch per partially-served
+        chunk with ``valid`` restricted to the owed rows and the rows'
+        split positions pinned in ``_positions`` — same geometry, same
+        ``_tag``, so re-prefilling them on a surviving replica stays
+        inside the declared program family and (by per-row beam
+        independence) reproduces the lost rows' results bit-exactly.
+        Scheduling state clears; the arena and stats stay (a retired
+        replica's commits are still real commits)."""
+        self.retired = True  # set FIRST: stops an abandoned watchdog
+        #                      thread the moment it wakes up
+        groups: Dict[int, List] = {}
+        hosts: Dict[int, Dict] = {}
+        for _slot, (pid, host, r) in sorted(self._busy.items()):
+            hosts[id(host)] = host
+            groups.setdefault(id(host), []).append((r, pid))
+        for entry in self._staged:
+            hosts[id(entry.host)] = entry.host
+            groups.setdefault(id(entry.host), []).extend(entry.rows)
+        payloads: List[Dict] = []
+        for hid, rows in groups.items():
+            host = hosts[hid]
+            requeued = dict(host)
+            valid = np.zeros_like(np.asarray(host["valid"]))  # firacheck: allow[HOST-SYNC] host["valid"] is the feeder's host-side numpy batch field; no device value exists here
+            positions = np.full(valid.shape[0], -1, dtype=np.int64)
+            for r, pid in rows:
+                valid[r] = True
+                positions[r] = pid
+            requeued["valid"] = valid
+            requeued["_positions"] = positions
+            payloads.append(requeued)
+        # canonical order for determinism: by the smallest owed position
+        payloads.sort(
+            key=lambda b: int(b["_positions"][b["_positions"] >= 0].min()))
+        self._busy.clear()
+        self._staged.clear()
+        self._staged_rows = 0
+        self._free = list(range(self.slots))
+        self._free_blocks = list(range(self._pool_blocks))
+        self._slot_blocks.clear()
+        return payloads
+
     def admit(self, host: Dict, index: int, device_batch=None) -> None:
         """Prefill one packed batch and stage its real rows for refill.
         ``device_batch``: the feeder's already-transferred wire batch;
@@ -699,10 +781,19 @@ class SlotEngine:
         cannot use a chunk committed elsewhere) re-ships the host batch,
         stripping the "_"-prefixed host-only fields exactly like the
         feeder does."""
+        if self._faults is not None:
+            self._faults.check("engine.prefill")
+        if self.retired:
+            return  # abandoned by a watchdog mid-dispatch; engine is dead
         if device_batch is None or self.device is not None:
             wire = {k: v for k, v in host.items() if not k.startswith("_")}
             device_batch = jax.device_put(wire, self.device)
         chunk = self._prefill(self.params, device_batch)
+        if self.retired:
+            # the watchdog expired while the prefill ran and the replica
+            # was retired: its requests were requeued elsewhere — staging
+            # them here too would decode them twice
+            return
         self._guard_step(self.label(PREFILL_KIND, host.get("_tag")))
         self._ensure_state(chunk)
         self.stats.prefills += 1
@@ -736,7 +827,11 @@ class SlotEngine:
         refill stops there and waits for harvests to return blocks
         (head-of-line, so admission order — hence output bytes — stays a
         pure function of the stream, pool size included)."""
-        while self._free and self._staged:
+        # retired-engine bail-early (docs/FAULTS.md): checked at every
+        # loop boundary so an abandoned watchdog thread that wakes up
+        # mid-refill stops mutating scheduling state a concurrent
+        # retire() is handing to the survivors
+        while not self.retired and self._free and self._staged:
             entry = self._staged[0]
             need = (paging.blocks_per_seq(entry.limit, self._block_size)
                     if self._paged else 0)
@@ -749,7 +844,7 @@ class SlotEngine:
                                   dtype=np.int32)  # P = unmapped sentinel
                           if self._paged else None)
             n_ins = 0
-            while self._free and entry.rows and (
+            while not self.retired and self._free and entry.rows and (
                     not self._paged or len(self._free_blocks) >= need):
                 r, pos_id = entry.rows.popleft()
                 slot = (self._free.pop(0) if refill_order == "fifo"
@@ -774,7 +869,17 @@ class SlotEngine:
         """Dispatch one step program (async — the fleet dispatches every
         replica's step before any harvest readback, so replica compute
         overlaps across chips)."""
-        self._state, self._pending_occ = self._step(self.params, self._state)
+        if self._faults is not None:
+            self._faults.check("engine.step")
+        if self.retired:
+            return  # abandoned by a watchdog mid-dispatch; engine is dead
+        new_state, new_occ = self._step(self.params, self._state)
+        if self.retired:
+            # the watchdog expired while the dispatch call was in flight:
+            # do NOT touch the shared compile guard or stats from this
+            # abandoned thread — the live loop owns them now
+            return
+        self._state, self._pending_occ = new_state, new_occ
         self._guard_step(self.label(STEP_LABEL))
         st = self.stats
         st.step_dispatches += 1
@@ -801,6 +906,10 @@ class SlotEngine:
         generator) for the same reason: a caller interleaving refill()
         between items would donate the arena out from under a pending
         row gather."""
+        if self._faults is not None:
+            self._faults.check("engine.harvest")
+        if self.retired:
+            return []  # abandoned by a watchdog; engine is dead
         stats = self.stats
         stats.occupied_slot_steps += int(np.array(
             jax.device_get(self._pending_occ)))
@@ -811,10 +920,27 @@ class SlotEngine:
             tokens, probs = self._state["tokens"], self._state["probs"]
             full_bytes = tokens.nbytes + probs.nbytes
             row_bytes = full_bytes // self.slots
+            # PHASE 1 — readbacks only, no bookkeeping: a watchdog expiry
+            # mid-device_get abandons this thread with every settled slot
+            # still in _busy, so retire() requeues ALL of them (popping
+            # as we read would strand the already-popped, never-delivered
+            # requests). Phase 2 is pure host dict work — microseconds,
+            # nothing left to hang on.
+            reads = []
             for s in newly:
+                if self.retired:
+                    return []  # abandoned by a watchdog mid-harvest
                 toks_s, probs_s = self._take_rows(tokens, probs,
                                                   jnp.int32(s))
                 self._guard_step(self.label(HARVEST_LABEL))
+                reads.append((
+                    s,
+                    np.array(jax.device_get(toks_s)),  # firacheck: allow[HOST-SYNC] harvest IS the engine's designated output boundary: settled beams must reach the host to be cooked into text, and the sliced row gather is exactly the copy this readback exists to make
+                    np.array(jax.device_get(probs_s))))  # firacheck: allow[HOST-SYNC] same harvest output boundary as the line above
+            if self.retired:
+                return []
+            # PHASE 2 — every readback landed: retire the bookkeeping
+            for s, toks_np, probs_np in reads:
                 pos_id, host, r = self._busy.pop(s)
                 self._free.append(s)
                 # the slot's block grant returns WHOLE — contents stay as
@@ -824,11 +950,9 @@ class SlotEngine:
                 stats.commits += 1
                 stats.harvest_row_reads += 1
                 stats.harvest_bytes_read += row_bytes
-                items.append(EngineItem(
-                    position=pos_id, host=host, row=r,
-                    tokens=np.array(jax.device_get(toks_s)),  # firacheck: allow[HOST-SYNC] harvest IS the engine's designated output boundary: settled beams must reach the host to be cooked into text, and the sliced row gather is exactly the copy this readback exists to make
-                    probs=np.array(jax.device_get(probs_s))))  # firacheck: allow[HOST-SYNC] same harvest output boundary as the line above
-            stats.harvest_bytes_saved += full_bytes - row_bytes * len(newly)
+                items.append(EngineItem(position=pos_id, host=host, row=r,
+                                        tokens=toks_np, probs=probs_np))
+            stats.harvest_bytes_saved += full_bytes - row_bytes * len(reads)
         return items
 
     def run(self, feed, *, refill_order: str = "fifo"

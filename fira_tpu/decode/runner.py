@@ -113,15 +113,29 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
              split: str = "test",
              guard=None,
              engine_slots: Optional[int] = None,
-             refill_order: str = "fifo") -> Dict[str, float]:
+             refill_order: str = "fifo",
+             faults=None) -> Dict[str, float]:
     """``guard``: an armed analysis.sanitizer.CompileGuard — each decode
     program must compile exactly once (warmup), then never again. The CLI
     arms it via ``--sanitize``; library callers use the
     sanitizer.sanitize() context manager so global config is restored.
     ``engine_slots``/``refill_order`` apply to the engine path only (the
     latter exists so the determinism tests can pin refill-order
-    independence)."""
+    independence).
+
+    ``faults``: an armed robust.faults.FaultInjector (None resolves from
+    ``cfg.inject_faults``; "" keeps it off at zero overhead). Drain mode
+    degrades like a batch job should: transient assembly faults are
+    absorbed by the feeder's ``cfg.robust_retries`` retry budget, a fleet
+    replica whose dispatch raises or blows ``cfg.dispatch_watchdog_s``
+    retires with its requests requeued onto survivors (parallel/fleet.py)
+    — and a fault nothing can absorb fails LOUDLY with the sample named
+    in the traceback, never silently truncating the output file."""
     cfg = cfg or dataset.cfg
+    if faults is None:
+        from fira_tpu.robust import faults as faults_lib
+
+        faults = faults_lib.injector_from(cfg)
     data = dataset.splits[split]
     vocab = dataset.word_vocab
     indices = dataset.split_indices[split]
@@ -144,10 +158,12 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
             from fira_tpu.parallel import fleet as fleet_lib
 
             eng = fleet_lib.EngineFleet(model, params, cfg, replicas=n_rep,
-                                        slots=engine_slots, guard=guard)
+                                        slots=engine_slots, guard=guard,
+                                        faults=faults)
         else:
             eng = engine_lib.SlotEngine(model, params, cfg,
-                                        slots=engine_slots, guard=guard)
+                                        slots=engine_slots, guard=guard,
+                                        faults=faults)
         if table is not None:
             if guard is not None:
                 # single engine: the classic (geometry x {prefill, step,
@@ -163,6 +179,16 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
                   f"{f' x {n_rep} replicas' if n_rep > 1 else ''} "
                   f"({', '.join(buckets_lib.geom_tag(g) for g in table)})",
                   flush=True)
+        else:
+            # unbucketed: pre-warm the single-geometry engine family
+            # (prefill + no-op insert/step + harvest gather) so the
+            # dispatch watchdog never reads a first-use XLA compile as a
+            # hung replica (docs/FAULTS.md)
+            from fira_tpu.data.batching import make_batch
+
+            warm = make_batch(data, np.arange(0), cfg,
+                              batch_size=cfg.test_batch_size)
+            eng.prewarm([(warm, None)])
         # the Feeder is constructed INSIDE the with (after the writer's
         # open succeeds): a failing open must not leak worker threads.
         # The fleet's feeder skips the device_put (put=False): which
@@ -170,7 +196,9 @@ def run_test(model: FiraModel, params, dataset: FiraDataset,
         # transfer happens at admission, onto the claiming replica's chip.
         with OrderedStreamWriter(out_path, expected=n_total) as writer, \
                 Feeder(tasks, num_workers=cfg.feeder_workers,
-                       depth=cfg.feeder_depth, put=n_rep == 1) as feed:
+                       depth=cfg.feeder_depth, put=n_rep == 1,
+                       retries=max(0, cfg.robust_retries),
+                       faults=faults) as feed:
             emit = make_emit(writer)
             for item in eng.run(feed, refill_order=refill_order):
                 emit(item.position, item.host, item.row, item.tokens,

@@ -36,18 +36,32 @@ Guard labels: each replica suffixes its labels with ``r<i>``
 replica compiles its own program set (per-device executables); the
 declared family is the union over replicas (:meth:`EngineFleet.labels`)
 and still closes at one compile per label.
+
+Graceful degradation (docs/FAULTS.md): a replica whose dispatch raises —
+or exceeds ``cfg.dispatch_watchdog_s`` wall seconds and is abandoned on
+its watchdog thread — is RETIRED: removed from the service rotation, its
+in-flight and staged requests requeued onto the surviving replicas (the
+dead replica excluded by construction), and the drain continues
+degraded. Requeued requests re-prefill inside the same declared program
+family and, by per-row beam independence, produce bit-identical results
+wherever they land — so the decoded file bytes of a run that lost a
+replica equal the no-fault run's exactly (pinned by tests/test_robust
+.py). Retirements and requeues are machine-recorded in FleetStats.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import jax
+import numpy as np
 
 from fira_tpu.config import FiraConfig
 from fira_tpu.decode.engine import EngineItem, EngineStats, SlotEngine
 from fira_tpu.model.model import FiraModel
+from fira_tpu.robust.watchdog import run_with_watchdog
 
 
 def fleet_divisibility_errors(cfg: FiraConfig) -> List[str]:
@@ -72,6 +86,11 @@ class FleetStats:
     """Aggregate + per-replica accounting for one fleet run."""
 
     replicas: List[EngineStats]
+    # degradation accounting (docs/FAULTS.md): one entry per retired
+    # replica ({"replica": tag, "error": str}) and the total requests
+    # requeued onto survivors across all retirements
+    retirements: List[Dict] = dataclasses.field(default_factory=list)
+    requeues: int = 0
 
     @property
     def commits(self) -> int:
@@ -119,6 +138,12 @@ class FleetStats:
             "per_replica_occupancy": [
                 round(r.slot_occupancy, 4) for r in self.replicas],
             "per_replica_commits": [r.commits for r in self.replicas],
+            # graceful-degradation record: which replicas were retired
+            # (dispatch raised / watchdog expired) and how many requests
+            # were requeued onto survivors
+            "retirements": len(self.retirements),
+            "retired_replicas": [r["replica"] for r in self.retirements],
+            "requeues": self.requeues,
         }
 
 
@@ -136,7 +161,7 @@ class EngineFleet:
 
     def __init__(self, model: FiraModel, params, cfg: FiraConfig, *,
                  replicas: int, slots: Optional[int] = None, guard=None,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None, faults=None):
         if replicas < 1:
             raise ValueError(f"fleet needs >= 1 replica, got {replicas}")
         total = int(slots or cfg.engine_slots or 0)
@@ -163,16 +188,25 @@ class EngineFleet:
             raise ValueError(f"{len(devices)} devices for {replicas} "
                              f"replicas")
         self.cfg = cfg
+        self.faults = faults
+        # degradation record (docs/FAULTS.md) — ``engines`` stays the
+        # FULL roster (stats/labels must keep counting a retired
+        # replica's commits); the run loop keeps its own live list
+        self.retirements: List[Dict] = []
+        self.requeues: int = 0
         self.engines = [
             SlotEngine(model, jax.device_put(params, devices[i]), cfg,
                        slots=per_replica, guard=guard, device=devices[i],
-                       tag=f"r{i}", pool_blocks=per_replica_pool)
+                       tag=f"r{i}", pool_blocks=per_replica_pool,
+                       faults=faults)
             for i in range(replicas)
         ]
 
     @property
     def stats(self) -> FleetStats:
-        return FleetStats([e.stats for e in self.engines])
+        return FleetStats([e.stats for e in self.engines],
+                          retirements=list(self.retirements),
+                          requeues=self.requeues)
 
     def labels(self, table=None) -> List[str]:
         """The fleet's declared program family: the union of every
@@ -187,13 +221,78 @@ class EngineFleet:
         for eng in self.engines:
             eng.prewarm(batches)
 
+    @staticmethod
+    def _as_payload(item) -> Dict:
+        """Normalize a feeder item into a requeue-able admission payload:
+        positions pinned in ``_positions`` (the unbucketed stream derives
+        them from the item index, exactly like SlotEngine.admit would),
+        so the SAME host batch can be admitted on ANY replica, including
+        after the first attempt's replica died mid-prefill."""
+        host = dict(item.host)
+        if host.get("_positions") is None:
+            C = host["valid"].shape[0]
+            host["_positions"] = (item.index * C
+                                  + np.arange(C, dtype=np.int64))
+        return host
+
+    def _retire(self, eng: SlotEngine, alive: List[SlotEngine],
+                pending: "collections.deque", err: BaseException) -> None:
+        """Retire one replica: drop it from the service rotation, requeue
+        every request it still owed at the FRONT of the shared admission
+        stream (they arrived earliest), and record the event. With no
+        survivors there is nothing to degrade onto — a drain run must
+        fail loudly, never hang."""
+        alive.remove(eng)
+        payloads = eng.retire()
+        # TOCTOU guard: an admit the watchdog abandoned can finish
+        # STAGING in the window between the timeout raising here and
+        # retire() flipping the retired flag — its chunk would then come
+        # back in `payloads` while ALSO still sitting at pending[0]
+        # (never popleft'd, because the admit call raised). Requeuing
+        # both copies would decode the same positions twice and blow the
+        # ordered writer's duplicate check, so rows already owed by a
+        # queued payload are masked out here (the serve loop dedups the
+        # same way via its `seen` set).
+        pending_pos = set()
+        for b in pending:
+            v = np.asarray(b["valid"], dtype=bool)  # firacheck: allow[HOST-SYNC] requeue payloads are host numpy batches (SlotEngine.retire / _as_payload); no device value exists in this dedup
+            pending_pos.update(int(p) for p in  # firacheck: allow[HOST-SYNC] requeue payloads are host numpy batches (SlotEngine.retire / _as_payload); no device value exists in this dedup
+                               np.asarray(b["_positions"])[v])  # firacheck: allow[HOST-SYNC] requeue payloads are host numpy batches (SlotEngine.retire / _as_payload); no device value exists in this dedup
+        n_req = 0
+        kept = []
+        for p in payloads:
+            v = np.asarray(p["valid"], dtype=bool).copy()  # firacheck: allow[HOST-SYNC] requeue payloads are host numpy batches (SlotEngine.retire / _as_payload); no device value exists in this dedup
+            pos = np.asarray(p["_positions"])  # firacheck: allow[HOST-SYNC] requeue payloads are host numpy batches (SlotEngine.retire / _as_payload); no device value exists in this dedup
+            for r in range(v.shape[0]):
+                if v[r] and int(pos[r]) in pending_pos:  # firacheck: allow[HOST-SYNC] requeue payloads are host numpy batches (SlotEngine.retire / _as_payload); no device value exists in this dedup
+                    v[r] = False
+            if v.any():
+                p["valid"] = v.astype(np.asarray(p["valid"]).dtype)  # firacheck: allow[HOST-SYNC] requeue payloads are host numpy batches (SlotEngine.retire / _as_payload); no device value exists in this dedup
+                kept.append(p)
+                n_req += int(v.sum())
+        for p in reversed(kept):
+            pending.appendleft(p)
+        self.requeues += n_req
+        self.retirements.append({"replica": eng.tag or "r0",
+                                 "error": f"{type(err).__name__}: {err}"})
+        if not alive:
+            raise RuntimeError(
+                f"all {len(self.engines)} fleet replicas retired; last "
+                f"error on {eng.tag or 'r0'}: {err}") from err
+
     def run(self, feed, *, refill_order: str = "fifo"
             ) -> Iterator[EngineItem]:
         """Drive the fleet over ``feed`` (data.feeder.FedBatch items from
         a ``put=False`` feeder — the shared admission queue). Yields one
         EngineItem per real sample as it settles, across all replicas;
         results are keyed by split position, so the ordered writer
-        downstream is replica-agnostic."""
+        downstream is replica-agnostic.
+
+        Degradation: each replica's service round runs under
+        ``cfg.dispatch_watchdog_s`` (0 = off) and a try/except — a raise
+        or watchdog expiry retires the replica and requeues its requests
+        (:meth:`_retire`); requeued payloads are admitted BEFORE fresh
+        feed items, onto whichever surviving replica wants input next."""
         if refill_order not in ("fifo", "lifo"):
             raise ValueError(f"refill_order {refill_order!r} not in "
                              f"{{'fifo', 'lifo'}}")
@@ -201,29 +300,65 @@ class EngineFleet:
             eng.begin_stream()
         feed_iter = iter(feed)
         exhausted = False
+        wd = float(self.cfg.dispatch_watchdog_s)
+        # re-admission payloads from retired replicas, served head-first
+        pending: "collections.deque" = collections.deque()
+        alive = [eng for eng in self.engines if not eng.retired]
         while True:
             # admission + refill, replica order (deterministic: which
             # replica gets a chunk never changes the chunk's results)
-            for eng in self.engines:
-                while not exhausted and eng.wants_input():
-                    try:
-                        item = next(feed_iter)
-                    except StopIteration:
-                        exhausted = True
-                        break
-                    eng.admit(item.host, item.index,
-                              None if item.device is item.host
-                              else item.device)
-                eng.refill(refill_order)
-            live = [eng for eng in self.engines if eng.in_flight()]
+            for eng in list(alive):
+                try:
+                    if self.faults is not None:
+                        self.faults.check("fleet.replica")
+                    while eng.wants_input():
+                        if not pending:
+                            if exhausted:
+                                break
+                            try:
+                                item = next(feed_iter)
+                            except StopIteration:
+                                exhausted = True
+                                break
+                            # normalize EVERY item to a requeue-able
+                            # payload first (positions pinned): if this
+                            # replica dies mid-prefill, the chunk being
+                            # admitted survives at the head of pending —
+                            # fleet feeds run put=False, so re-shipping
+                            # at admission was the contract already
+                            pending.append(self._as_payload(item))
+                        payload = pending[0]   # PEEK: a failed admit
+                        #                        leaves it queued for the
+                        #                        next surviving replica
+                        run_with_watchdog(
+                            lambda p=payload: eng.admit(p, 0), wd,
+                            label=f"prefill[{eng.tag}]")
+                        pending.popleft()
+                    run_with_watchdog(lambda: eng.refill(refill_order), wd,
+                                      label=f"refill[{eng.tag}]")
+                except Exception as e:
+                    self._retire(eng, alive, pending, e)
+            live = [eng for eng in alive if eng.in_flight()]
             if not live:
-                if exhausted:
+                if exhausted and not pending:
                     return
                 continue  # nothing in flight yet: pull more input
             # dispatch EVERY live replica's step before any harvest
             # readback: replica compute overlaps across chips while the
             # host walks the fleet
             for eng in live:
-                eng.step_dispatch()
+                try:
+                    run_with_watchdog(eng.step_dispatch, wd,
+                                      label=f"step[{eng.tag}]")
+                except Exception as e:
+                    self._retire(eng, alive, pending, e)
             for eng in live:
-                yield from eng.harvest()
+                if eng.retired:
+                    continue
+                try:
+                    items = run_with_watchdog(eng.harvest, wd,
+                                              label=f"harvest[{eng.tag}]")
+                except Exception as e:
+                    self._retire(eng, alive, pending, e)
+                    continue
+                yield from items

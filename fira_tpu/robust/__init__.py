@@ -1,0 +1,24 @@
+"""Fault injection + graceful degradation for the serving stack
+(docs/FAULTS.md).
+
+Every runtime layer built before this package was fail-stop: a feeder
+worker exception poisoned the whole feed, a fleet replica whose dispatch
+raised or hung took down the entire run, and one malformed request killed
+the serve loop. This package holds the machinery that turns those into
+*degradation* instead of collapse, and the seeded fault-injection
+registry that proves it deterministically in tier-1:
+
+- :mod:`fira_tpu.robust.faults` — named injection sites armed by a
+  parse-time-validated spec (``site:kind:rate:seed``), deterministic
+  given the seed, off by default with zero hot-path overhead;
+- :mod:`fira_tpu.robust.watchdog` — a per-dispatch wall-clock watchdog
+  (run the dispatch in a worker thread, abandon it on expiry) backing
+  replica retirement in the fleet/serve loops and the dev-gate skip in
+  train/loop.py.
+"""
+
+from fira_tpu.robust.faults import (FaultSpec, FaultInjector,  # noqa: F401
+                                    InjectedFault, injector_from,
+                                    parse_fault_specs, robust_errors)
+from fira_tpu.robust.watchdog import (WatchdogTimeout,  # noqa: F401
+                                      run_with_watchdog)

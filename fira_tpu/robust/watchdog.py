@@ -1,0 +1,69 @@
+"""Per-dispatch wall-clock watchdog (docs/FAULTS.md).
+
+A hung device dispatch (driver wedge, injected ``hang`` fault, a remote
+backend that stopped answering) cannot be interrupted from Python — but
+it CAN be abandoned: run the dispatch in a worker thread, wait the
+timeout, and on expiry raise :class:`WatchdogTimeout` to the caller
+while the thread runs on into the void. The caller MUST then retire
+whatever state the abandoned call mutates (the fleet/serve loops retire
+the whole replica — its engine sets ``retired`` and every steppable
+piece bails early if the abandoned thread ever wakes up; see
+decode/engine.py), because the thread may still complete later.
+
+``timeout_s <= 0`` is the off switch: the callable runs inline on the
+caller's thread with zero overhead — the hot-path default. When ARMED,
+every guarded dispatch pays one thread spawn+join (~100 µs on this
+class of host) — an accepted cost for a robustness/debugging mode; a
+deployment that arms the watchdog on a latency-critical path should
+move to a persistent per-replica worker thread first.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+
+class WatchdogTimeout(RuntimeError):
+    """A dispatch exceeded its wall-clock budget and was abandoned."""
+
+
+def run_with_watchdog(fn: Callable[[], Any], timeout_s: float, *,
+                      label: str = "",
+                      cancel_event: "threading.Event" = None) -> Any:
+    """Run ``fn()`` under a ``timeout_s`` wall-clock watchdog.
+
+    ``timeout_s <= 0``: call inline (no thread, no overhead). Otherwise
+    the call runs on a daemon worker thread; if it has not returned
+    within the timeout, :class:`WatchdogTimeout` raises HERE and the
+    thread is abandoned — the caller owns retiring the state it may
+    still mutate. The callable's own exception (if it finishes in time)
+    re-raises unchanged.
+
+    ``cancel_event``: set on expiry BEFORE the timeout raises — a
+    cooperative kill switch for callables that can poll it (the dev gate
+    checks it per eval batch, train/loop.py) so an abandoned-but-alive
+    call stops doing work instead of racing its replacement."""
+    if timeout_s <= 0:
+        return fn()
+    box: dict = {}
+
+    def body() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # re-raised on the caller's thread
+            box["error"] = e
+
+    t = threading.Thread(target=body, name="fira-dispatch-watchdog",
+                         daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        if cancel_event is not None:
+            cancel_event.set()
+        raise WatchdogTimeout(
+            f"dispatch{f' {label}' if label else ''} exceeded the "
+            f"{timeout_s:.3f}s wall-clock watchdog and was abandoned")
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import json
 import math
 import os
 import time
@@ -72,6 +73,13 @@ from fira_tpu.decode.engine import SlotEngine
 from fira_tpu.decode.runner import output_name, sample_emitter
 from fira_tpu.decode.stream import OrderedStreamWriter
 from fira_tpu.model.model import FiraModel
+from fira_tpu.robust import faults as faults_lib
+from fira_tpu.robust.watchdog import WatchdogTimeout, run_with_watchdog
+
+# serve_metrics snapshot cadence: the partial artifact refreshes every
+# this many scheduler rounds (plus once at startup and once on abort),
+# so a SIGKILL at any point leaves a recent, valid-JSON snapshot
+SNAPSHOT_EVERY_ROUNDS = 16
 
 
 # --------------------------------------------------------------------------
@@ -172,7 +180,7 @@ class RequestRecord:
     position: int            # split-local sample position
     arrival_t: float         # scheduled (open-loop) arrival time
     status: str = "pending"  # queued|staged|seated|done|shed_queue_full|
-                             # shed_deadline
+                             # shed_deadline|shed_error
     arrival_round: int = -1  # step-dispatch counter at arrival (deadline base)
     admit_t: float = math.nan       # prefill dispatched (chunk staged)
     seat_t: float = math.nan        # inserted into a slot
@@ -181,6 +189,10 @@ class RequestRecord:
     done_t: float = math.nan        # harvested (all beams settled)
     done_round: int = -1
     deadline_missed: bool = False   # completed, but past its deadline
+    # poison-quarantine / retirement accounting (docs/FAULTS.md)
+    error: Optional[str] = None     # recorded failure when shed_error
+    retries: int = 0                # assembly/admission/prefill retries paid
+    requeues: int = 0               # times re-queued off a retired replica
 
     @property
     def queue_wait_s(self) -> float:
@@ -213,6 +225,12 @@ class ServeStats:
     peak_queue_depth: int = 0
     shed_queue_full: int = 0
     shed_deadline: int = 0
+    # graceful degradation (docs/FAULTS.md): requests shed with a
+    # recorded error (poison quarantine / lost replicas), replicas
+    # retired mid-run, and requests requeued off retired replicas
+    shed_error: int = 0
+    retirements: List[Dict] = dataclasses.field(default_factory=list)
+    requeues: int = 0
 
     def summary(self) -> Dict:
         done = [r for r in self.records if r.status == "done"]
@@ -227,6 +245,11 @@ class ServeStats:
             "completed": len(done),
             "shed_queue_full": self.shed_queue_full,
             "shed_deadline": self.shed_deadline,
+            "shed_error": self.shed_error,
+            "replica_retirements": len(self.retirements),
+            "retired_replicas": [r["replica"] for r in self.retirements],
+            "requeued_requests": self.requeues,
+            "request_retries": sum(r.retries for r in self.records),
             "deadline_missed": sum(r.deadline_missed for r in done),
             "rounds": self.rounds,
             "admits": self.admits,
@@ -262,7 +285,7 @@ class ServeLoop:
     def __init__(self, engines: Sequence[SlotEngine], cfg: FiraConfig, *,
                  arrival_times: np.ndarray, feed, table, assignment,
                  templates: Dict[int, Dict], clock, emit, shed,
-                 refill_order: str = "fifo"):
+                 refill_order: str = "fifo", faults=None, snapshot=None):
         self.engines = list(engines)
         self.cfg = cfg
         self.clock = clock
@@ -276,11 +299,22 @@ class ServeLoop:
         self._budget = max(1, int(cfg.serve_prefill_budget))
         self._deadline = max(0, int(cfg.serve_deadline_steps))
         self._cap = max(0, int(cfg.serve_queue_cap))
+        # graceful degradation knobs (docs/FAULTS.md): the poison-request
+        # retry budget, the per-dispatch wall-clock watchdog (0 = off),
+        # the armed fault injector (None = off, zero overhead), and the
+        # partial-metrics snapshot hook (crash contract)
+        self._retries = max(0, int(cfg.robust_retries))
+        self._watchdog = float(cfg.dispatch_watchdog_s)
+        self._faults = faults
+        self._snapshot = snapshot
         self._times = np.asarray(arrival_times, dtype=np.float64)
         self._feed_iter = iter(feed)
         self._arr_idx = 0
         self._rr = 0   # admission round-robin start (load balance)
         self._queue: "collections.deque[_Queued]" = collections.deque()
+        # single-row payloads of every taken-but-unfinished request, by
+        # position: the requeue source when a replica retires mid-flight
+        self._payloads: Dict[int, _Queued] = {}
         self._awaiting_first_step: List[RequestRecord] = []
         self._final = 0
         self.stats = ServeStats(records=[
@@ -290,16 +324,29 @@ class ServeLoop:
     # --- pieces ---------------------------------------------------------
 
     def _poll_arrivals(self, now: float) -> None:
-        """Move every due request into the admission queue; an arrival
-        that finds the bounded queue full is shed on the spot."""
+        """Move every due request into the admission queue. An arrival is
+        shed on the spot when the bounded queue is full, when its payload
+        arrived POISONED (the feeder's per-task error channel: assembly
+        failed even after its worker-side retries — recorded, never a
+        re-raise), or when the serve.admit fault site rejects it past the
+        retry budget."""
         while self._arr_idx < len(self._times) \
                 and self._times[self._arr_idx] <= now:
             item = next(self._feed_iter)   # pre-assembled, split order
             i = self._arr_idx
             rec = self.stats.records[i]
             rec.arrival_round = self.stats.rounds
-            if self._cap and len(self._queue) >= self._cap:
+            rec.retries += int(item.retries)  # firacheck: allow[HOST-SYNC] FedBatch.retries is a host int counter stamped by the feeder worker; no device value exists here
+            if item.error is not None:
+                # poison-request quarantine: the request's assembly raised
+                # (and its feeder-side retries were spent) — shed with the
+                # error recorded; its output position holds an empty line
+                rec.error = str(item.error)
+                self._shed(rec, "shed_error")
+            elif self._cap and len(self._queue) >= self._cap:
                 self._shed(rec, "shed_queue_full")
+            elif not self._admit_gate(rec):
+                pass  # serve.admit fault past the retry budget: shed inside
             else:
                 rec.status = "queued"
                 bucket = (int(self._assignment[i])  # firacheck: allow[HOST-SYNC] host numpy bucket-assignment array (data/buckets.assign_buckets) — admission runs on host index data only, never device values
@@ -309,13 +356,48 @@ class ServeLoop:
         self.stats.peak_queue_depth = max(self.stats.peak_queue_depth,
                                           len(self._queue))
 
+    def _backoff(self, attempt: int) -> None:
+        """Quarantine retry backoff: real sleep on the wall clock only —
+        a virtual-clock replay is deterministic by construction (every
+        retry is a fresh keyed draw, not a time-dependent one), so
+        burning real wall time per retried fault would only slow the
+        replay down."""
+        if isinstance(self.clock, WallClock):
+            time.sleep(faults_lib.backoff_s(attempt))
+
+    def _admit_gate(self, rec: RequestRecord) -> bool:
+        """The serve.admit fault site, with the quarantine retry policy:
+        every attempt is a fresh deterministic draw, so a transient
+        admission fault is absorbed by the retry budget and a persistent
+        one sheds the request with its error recorded."""
+        if self._faults is None or not self._faults.armed("serve.admit"):
+            return True
+        attempt = 0
+        while True:
+            try:
+                self._faults.check("serve.admit")
+                return True
+            except Exception as e:
+                if attempt < self._retries:
+                    attempt += 1
+                    rec.retries += 1
+                    self._backoff(attempt)
+                    continue
+                rec.error = (f"admission rejected after {attempt + 1} "
+                             f"attempt(s): {e}")
+                self._shed(rec, "shed_error")
+                return False
+
     def _shed(self, rec: RequestRecord, status: str) -> None:
         rec.status = status
         if status == "shed_queue_full":
             self.stats.shed_queue_full += 1
-        else:
+        elif status == "shed_deadline":
             self.stats.shed_deadline += 1
+        else:
+            self.stats.shed_error += 1
         self._final += 1
+        self._payloads.pop(rec.position, None)
         self.shed_cb(rec)
 
     def _shed_deadlines(self) -> None:
@@ -341,6 +423,10 @@ class ServeLoop:
             (take if e.bucket == bucket else rest).append(e)
         rest.extend(self._queue)
         self._queue = rest
+        for e in take:
+            # keep the single-row payload until the request finishes: the
+            # requeue source if the replica serving it retires mid-flight
+            self._payloads[e.record.position] = e
         return bucket, take
 
     def _form_batch(self, bucket: int, take: List[_Queued]) -> Dict:
@@ -359,6 +445,97 @@ class ServeLoop:
             batch["_tag"] = buckets_lib.geom_tag(self._table[bucket])
         return batch
 
+    def _prefill_quarantined(self, eng: SlotEngine, batch: Dict,
+                             take: List[_Queued]) -> Optional[bool]:
+        """One prefill dispatch under the quarantine policy: a RAISE is a
+        request problem — retried with backoff (every attempt a fresh
+        fault draw), then the whole chunk shed with its error recorded; a
+        WATCHDOG EXPIRY is a replica problem — the replica retires and
+        the chunk requeues. Returns True (staged), False (chunk shed), or
+        None (replica retired — the caller moves on)."""
+        attempt = 0
+        while True:
+            try:
+                run_with_watchdog(lambda: eng.admit(batch, 0),
+                                  self._watchdog,
+                                  label=f"serve_prefill[{eng.tag or 'r0'}]")
+                return True
+            except WatchdogTimeout as e:
+                self._retire_replica(eng, e, requeue=take)
+                return None
+            except Exception as e:
+                if attempt < self._retries:
+                    attempt += 1
+                    for el in take:
+                        el.record.retries += 1
+                    self._backoff(attempt)
+                    continue
+                for el in take:
+                    el.record.error = (f"prefill failed after "
+                                       f"{attempt + 1} attempt(s): {e}")
+                    self._shed(el.record, "shed_error")
+                return False
+
+    def _retire_replica(self, eng: SlotEngine, err: BaseException, *,
+                        requeue: Optional[List[_Queued]] = None) -> None:
+        """Retire one replica (dispatch raised or blew the watchdog):
+        drop it from the rotation and push every request it still owed —
+        seated, staged, plus the caller's un-staged ``requeue`` chunk —
+        back to the FRONT of the admission queue in position order (they
+        arrived earliest). Their lifecycle stamps reset to 'queued'; the
+        deadline clock does NOT reset (arrival_round stands), so a
+        request that cannot be re-served in time is recorded-shed, never
+        silently dropped. Stamps, counts, and the retired replica are
+        machine-recorded in ServeStats."""
+        if eng not in self.engines:
+            return
+        owed = set(eng.pending_positions())
+        eng.retire()
+        self.engines.remove(eng)
+        self.stats.retirements.append(
+            {"replica": eng.tag or "r0",
+             "error": f"{type(err).__name__}: {err}"})
+        entries: List[_Queued] = []
+        seen: set = set()
+        for pos in owed:
+            e = self._payloads.get(pos)
+            if e is not None and pos not in seen:
+                seen.add(pos)
+                entries.append(e)
+        for e in (requeue or []):
+            if e.record.position not in seen:
+                seen.add(e.record.position)
+                entries.append(e)
+        entries.sort(key=lambda e: e.record.position)
+        for e in entries:
+            rec = e.record
+            rec.requeues += 1
+            rec.status = "queued"
+            rec.admit_t = rec.seat_t = rec.first_step_t = math.nan
+        self.stats.requeues += len(entries)
+        for e in reversed(entries):
+            self._queue.appendleft(e)
+        self._awaiting_first_step = [
+            r for r in self._awaiting_first_step if r.status == "seated"]
+        self._rr = self._rr % len(self.engines) if self.engines else 0
+
+    def _shed_all_remaining(self, reason: str) -> None:
+        """No live replicas: every request not yet final is shed with the
+        reason recorded — the run terminates with a position-complete
+        output file and an honest metrics artifact, never a hang."""
+        while self._queue:
+            e = self._queue.popleft()
+            e.record.error = e.record.error or reason
+            self._shed(e.record, "shed_error")
+        while self._arr_idx < len(self._times):
+            item = next(self._feed_iter)
+            rec = self.stats.records[self._arr_idx]
+            rec.retries += int(item.retries)  # firacheck: allow[HOST-SYNC] FedBatch.retries is a host int counter stamped by the feeder worker; no device value exists here
+            rec.error = rec.error or (str(item.error) if item.error
+                                      else reason)
+            self._shed(rec, "shed_error")
+            self._arr_idx += 1
+
     def _admit(self) -> None:
         """Budgeted admission, replica round-robin: at most
         ``serve_prefill_budget`` prefill dispatches per replica between
@@ -370,12 +547,19 @@ class ServeLoop:
         deterministic one)."""
         admitted = 0
         order = (self.engines[self._rr:] + self.engines[:self._rr])
-        self._rr = (self._rr + 1) % len(self.engines)
+        self._rr = (self._rr + 1) % len(self.engines) if self.engines else 0
         for eng in order:
+            if eng not in self.engines:
+                continue  # retired earlier in this very round
             n = 0
             while n < self._budget and self._queue and eng.wants_input():
                 bucket, take = self._take_chunk()
-                eng.admit(self._form_batch(bucket, take), 0)
+                staged = self._prefill_quarantined(
+                    eng, self._form_batch(bucket, take), take)
+                if staged is None:
+                    break  # replica retired; its chunk is requeued
+                if not staged:
+                    continue  # chunk shed; the queue head moved on
                 self.clock.on_prefill()
                 t = self.clock.now()
                 for e in take:
@@ -383,7 +567,14 @@ class ServeLoop:
                     e.record.status = "staged"
                 n += 1
             admitted += n
-            eng.refill(self.refill_order)
+            if eng not in self.engines:
+                continue
+            try:
+                run_with_watchdog(lambda: eng.refill(self.refill_order),
+                                  self._watchdog,
+                                  label=f"serve_refill[{eng.tag or 'r0'}]")
+            except Exception as e:
+                self._retire_replica(eng, e)
         self.stats.admits += admitted
         self.stats.max_admits_per_round = max(
             self.stats.max_admits_per_round, admitted)
@@ -405,7 +596,18 @@ class ServeLoop:
             # a just-constructed engine; required when a caller reuses a
             # warmed engine across serving runs — scripts/serve_bench.py)
             eng.begin_stream()
+        if self._snapshot is not None:
+            self._snapshot(self)   # a valid partial artifact exists from
+            #                        the very first moment (kill contract)
         while self._final < n:
+            if not self.engines:
+                # every replica retired: shed the remainder with the
+                # reason recorded — position-complete output, no hang
+                last = (self.stats.retirements[-1]["error"]
+                        if self.stats.retirements else "unknown")
+                self._shed_all_remaining(
+                    f"no live replicas (all retired; last error: {last})")
+                break
             self._poll_arrivals(self.clock.now())
             self._shed_deadlines()
             self._admit()
@@ -420,17 +622,35 @@ class ServeLoop:
                     self.clock.advance_to(self._times[self._arr_idx])
                     continue
                 if self._final < n:   # pragma: no cover - loop invariant
+                    # a retirement always requeues into self._queue, so
+                    # final < n still implies queued/staged/arriving work
                     raise RuntimeError(
                         "serve loop stalled with requests unaccounted for")
                 break
             for eng in live:
-                eng.step_dispatch()
+                try:
+                    if self._faults is not None:
+                        self._faults.check("fleet.replica")
+                    run_with_watchdog(eng.step_dispatch, self._watchdog,
+                                      label=f"serve_step[{eng.tag or 'r0'}]")
+                except Exception as e:
+                    self._retire_replica(eng, e)
             self.clock.on_step()
             self.stats.rounds += 1
-            items = [it for eng in live for it in eng.harvest()]
+            items = []
+            for eng in live:
+                if eng.retired:
+                    continue
+                try:
+                    items.extend(run_with_watchdog(
+                        eng.harvest, self._watchdog,
+                        label=f"serve_harvest[{eng.tag or 'r0'}]"))
+                except Exception as e:
+                    self._retire_replica(eng, e)
             t = self.clock.now()   # post-harvest: the honest observation
             for rec in self._awaiting_first_step:
-                rec.first_step_t = t
+                if rec.status == "seated":   # not requeued mid-round
+                    rec.first_step_t = t
             self._awaiting_first_step = []
             for it in items:
                 rec = self.stats.records[it.position]
@@ -441,8 +661,12 @@ class ServeLoop:
                                        > self._deadline):
                     rec.deadline_missed = True
                 self._final += 1
+                self._payloads.pop(it.position, None)
                 self.stats.completions.append(it.position)
                 self.emit(it.position, it.host, it.row, it.tokens, it.probs)
+            if (self._snapshot is not None
+                    and self.stats.rounds % SNAPSHOT_EVERY_ROUNDS == 0):
+                self._snapshot(self)
         return self.stats
 
 
@@ -454,13 +678,46 @@ def _request_tasks(data, cfg: FiraConfig, n: int, table, assignment):
     """One single-row ``make_batch`` task per request, split order — the
     async Feeder pre-assembles request payloads ahead of their arrival
     (an open-loop generator knows its requests up front; arrival TIME, not
-    assembly, is what admission is gated on)."""
+    assembly, is what admission is gated on). Each task carries a ``note``
+    (request position + bucket geometry) so a poisoned payload's recorded
+    error names its sample."""
     from fira_tpu.data.batching import make_batch
+    from fira_tpu.data.feeder import task_note
 
     for i in range(n):
         geom = table[int(assignment[i])] if table is not None else None  # firacheck: allow[HOST-SYNC] host numpy bucket-assignment array — task generation is pure host-side planning
-        yield (lambda i=i, geom=geom: make_batch(
+        task = (lambda i=i, geom=geom: make_batch(
             data, np.asarray([i]), cfg, batch_size=1, geom=geom))  # firacheck: allow[HOST-SYNC] np.asarray of a host int list builds the make_batch index chunk; no device value exists here
+        task.note = task_note(
+            [i], geom_tag=buckets_lib.geom_tag(geom) if geom else None,
+            site="serve request")
+        yield task
+
+
+def _json_safe_records(records: List[RequestRecord]) -> List[Dict]:
+    """Request-record dicts with NaN lifecycle stamps (shed requests were
+    never seated) serialized as null — the metrics artifact is strict
+    JSON (allow_nan=False)."""
+    out = []
+    for r in records:
+        d = dataclasses.asdict(r)
+        out.append({k: (None if isinstance(v, float) and v != v else v)
+                    for k, v in d.items()})
+    return out
+
+
+def write_metrics_atomic(path: str, payload: Dict) -> str:
+    """Write a metrics artifact ATOMICALLY: full dump to ``path + ".tmp"``
+    then one ``os.replace`` — a kill at any instant leaves either the
+    previous complete file or the new one, never a torn JSON document
+    (the OrderedStreamWriter crash discipline applied to metrics)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, allow_nan=False)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
 
 
 def serve_split(model: FiraModel, params, dataset: FiraDataset,
@@ -476,7 +733,9 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
                 clock: str = "wall",
                 step_cost_s: float = 1.0,
                 prefill_cost_s: float = 1.0,
-                engine=None) -> Dict:
+                engine=None,
+                faults=None,
+                metrics_path: Optional[str] = None) -> Dict:
     """Serve the first ``len(arrival_times)`` samples of ``split`` as an
     open-loop request stream (request ``i`` = split position ``i``,
     arriving at ``arrival_times[i]``). Writes the same position-ordered
@@ -491,8 +750,18 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
     the bench reuses one warm engine across swept rates so the latency
     rows measure serving, not per-run cold compiles. The caller owns
     its cfg consistency (and stats resets between timed runs); the
-    scheduler state itself is reset per run."""
+    scheduler state itself is reset per run.
+
+    ``faults``: an armed robust.faults.FaultInjector (None resolves from
+    ``cfg.inject_faults`` — "" keeps it off at zero overhead).
+    ``metrics_path``: when set, the serve metrics artifact is maintained
+    THROUGH the run — a ``<path>.partial`` snapshot refreshes atomically
+    every few scheduler rounds (and once on abort), and the final file
+    is written atomically (tmp + rename) at completion, matching the
+    ordered writer's crash contract (docs/FAULTS.md)."""
     cfg = cfg or dataset.cfg
+    if faults is None:
+        faults = faults_lib.injector_from(cfg)
     data = dataset.splits[split]
     vocab = dataset.word_vocab
     indices = dataset.split_indices[split]
@@ -532,11 +801,12 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
 
             owner = fleet_lib.EngineFleet(model, params, cfg,
                                           replicas=n_rep,
-                                          slots=engine_slots, guard=guard)
+                                          slots=engine_slots, guard=guard,
+                                          faults=faults)
             engines = owner.engines
         else:
             owner = SlotEngine(model, params, cfg, slots=engine_slots,
-                               guard=guard)
+                               guard=guard, faults=faults)
             engines = [owner]
     if table is not None:
         if engine is None:
@@ -550,14 +820,39 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
         from fira_tpu.data.batching import make_batch
 
         templates = {0: make_batch(data, np.arange(0), cfg, batch_size=bs)}
+        if engine is None:
+            # unbucketed: pre-warm the single-geometry program family too
+            # (prefill + no-op insert/step + harvest gather) — the
+            # dispatch watchdog depends on post-warmup dispatches never
+            # paying a first-use XLA compile (docs/FAULTS.md)
+            owner.prewarm([(templates[0], None)])
 
     os.makedirs(out_dir, exist_ok=True)
     out_path = os.path.join(out_dir, output_name(ablation))
     bleu_by_pos: Dict[int, float] = {}
+
+    snapshot = None
+    if metrics_path:
+        partial_path = metrics_path + ".partial"
+
+        def snapshot(loop):
+            write_metrics_atomic(partial_path, {
+                "in_progress": True,
+                "serve": loop.stats.summary(),
+                "engine": owner.stats.summary(),
+                **({"faults": faults.summary()} if faults else {}),
+                "request_records": _json_safe_records(loop.stats.records),
+            })
+
     with OrderedStreamWriter(out_path, expected=n_req) as writer, \
             Feeder(_request_tasks(data, cfg, n_req, table, assignment),
                    num_workers=cfg.feeder_workers, depth=cfg.feeder_depth,
-                   put=False) as feed:
+                   put=False,
+                   # the per-task error channel: a poisoned payload is
+                   # retried in the worker, then delivered WITH its error
+                   # for the loop to shed — never a consumer re-raise
+                   on_error="record", retries=max(0, cfg.robust_retries),
+                   faults=faults) as feed:
         emit = sample_emitter(writer, vocab=vocab, cfg=cfg,
                               bleu_by_pos=bleu_by_pos, n_total=n_req,
                               var_maps=var_maps, indices=indices)
@@ -568,15 +863,37 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
             # a shed request still owns its output position: an empty
             # line keeps the file position-complete and deterministic
             shed=lambda rec: writer.add(rec.position, "\n"),
-            refill_order=refill_order)
-        stats = loop.run()
+            refill_order=refill_order, faults=faults, snapshot=snapshot)
+        try:
+            stats = loop.run()
+        except BaseException:
+            # abort flush: the freshest partial metrics snapshot survives
+            # the crash alongside the ordered writer's .partial prefix
+            if snapshot is not None:
+                try:
+                    snapshot(loop)
+                except Exception:
+                    pass
+            raise
     n_done = len(bleu_by_pos)
     total_bleu = sum(bleu_by_pos[p] for p in sorted(bleu_by_pos))
-    return {
+    result = {
         "sentence_bleu": total_bleu / max(n_done, 1),
         "n": float(n_done),
         "output_path": out_path,
         "serve": stats.summary(),
         "engine": owner.stats.summary(),
+        **({"faults": faults.summary()} if faults else {}),
         "request_records": [dataclasses.asdict(r) for r in stats.records],
     }
+    if metrics_path:
+        write_metrics_atomic(metrics_path, {
+            "serve": result["serve"],
+            "engine": result["engine"],
+            **({"faults": faults.summary()} if faults else {}),
+            "request_records": _json_safe_records(stats.records),
+        })
+        if os.path.exists(metrics_path + ".partial"):
+            os.remove(metrics_path + ".partial")
+        result["metrics_path"] = metrics_path
+    return result

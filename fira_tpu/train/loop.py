@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 from typing import Dict, List, Optional
 
 import jax
@@ -40,6 +41,7 @@ from fira_tpu.decode.text import cook_prediction, deanonymize, reference_words
 from fira_tpu.eval.dev_bleu import nltk_sentence_bleu
 from fira_tpu.model.model import FiraModel
 from fira_tpu.parallel import mesh as pmesh
+from fira_tpu.robust.watchdog import WatchdogTimeout, run_with_watchdog
 from fira_tpu.train import step as step_lib
 from fira_tpu.train.state import CheckpointManager, TrainState, init_state
 from fira_tpu.utils import profiling
@@ -102,10 +104,16 @@ def _eval_tasks(data, cfg: FiraConfig, plan=None):
 def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
             var_maps: Optional[List[Dict[str, str]]] = None,
             split: str = "valid", guard=None,
-            eval_plan=None) -> tuple[float, str]:
+            eval_plan=None, cancel=None) -> tuple[float, str]:
     """Greedy teacher-forced validation (run_model.py:118-184). Returns
     (mean sentence BLEU over the split, dev_output text — always in split
-    order, even when the bucket packer reordered the batch stream)."""
+    order, even when the bucket packer reordered the batch stream).
+
+    ``cancel``: zero-arg callable polled per eval batch — the dispatch
+    watchdog's cooperative kill switch (docs/FAULTS.md): a gate the
+    watchdog abandoned must STOP dispatching eval programs and stepping
+    the shared compile guard instead of racing the resumed training
+    loop; raising here closes the eval feeder via the context manager."""
     data = dataset.splits[split]
     vocab = dataset.word_vocab
     indices = dataset.split_indices[split]
@@ -116,6 +124,9 @@ def run_dev(dev_step, params, dataset: FiraDataset, cfg: FiraConfig,
                 num_workers=cfg.feeder_workers,
                 depth=cfg.feeder_depth) as feed:
         for item in feed:
+            if cancel is not None and cancel():
+                raise WatchdogTimeout(
+                    "dev gate abandoned by the dispatch watchdog")
             batch = item.host  # numpy fields for host-side text cooking
             # firacheck: allow[HOST-SYNC] dev gate IS a designated sync boundary: teacher-forced ids must reach the host for BLEU scoring (README Design notes)
             ids = np.asarray(jax.device_get(dev_step(params, item.device)))
@@ -435,16 +446,36 @@ def train(dataset: FiraDataset, cfg: Optional[FiraConfig] = None, *,
                         _materialize(last_metrics["loss"])
                     sync_tick()
                     meter.pause()  # dev time is not train time
-                    cur_bleu, dev_text = run_dev(dev_step, state.params,
-                                                 dataset, cfg, var_maps,
-                                                 guard=guard,
-                                                 eval_plan=dev_plan)
-                    better = cur_bleu > best_bleu
-                    log.gate(epoch, idx, cur_bleu, better)
-                    if better:
-                        best_bleu = cur_bleu
-                        ckpt.save_best(state.params)
-                        log.dev_output(dev_text)
+                    # dispatch watchdog (docs/FAULTS.md): a dev gate that
+                    # wedges (hung eval dispatch, stuck eval feeder) is
+                    # ABANDONED after cfg.dispatch_watchdog_s and skipped
+                    # with a recorded warning — training continues
+                    # degraded instead of the whole run hanging on its
+                    # own evaluation. 0 (default) = off, call inline.
+                    gate_cancel = threading.Event()
+                    try:
+                        cur_bleu, dev_text = run_with_watchdog(
+                            lambda: run_dev(dev_step, state.params,
+                                            dataset, cfg, var_maps,
+                                            guard=guard,
+                                            eval_plan=dev_plan,
+                                            cancel=gate_cancel.is_set),
+                            float(cfg.dispatch_watchdog_s),  # firacheck: allow[HOST-SYNC] config scalar, not a device value; the gate is already a designated sync boundary
+                            label=f"dev_gate[e{epoch}b{idx}]",
+                            cancel_event=gate_cancel)
+                    except WatchdogTimeout as e:
+                        w = (f"dev gate at epoch {epoch} batch {idx} "
+                             f"skipped: {e}; training continues without "
+                             f"this gate's checkpoint decision")
+                        log.console(f"WARNING: {w}")
+                        warnings.append(w)
+                    else:
+                        better = cur_bleu > best_bleu
+                        log.gate(epoch, idx, cur_bleu, better)
+                        if better:
+                            best_bleu = cur_bleu
+                            ckpt.save_best(state.params)
+                            log.dev_output(dev_text)
                     meter.start()
 
                 if (profile_window and not profiling_active

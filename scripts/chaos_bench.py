@@ -1,0 +1,281 @@
+"""Chaos bench: seeded faults through the serving stack -> docs/CHAOS_BENCH_r01.jsonl.
+
+The graceful-degradation machinery (fira_tpu/robust — docs/FAULTS.md) is
+only real if it is exercised: this script injects a seeded fault at each
+registered site through a serve run (the serve_bench.py --smoke shape:
+fixed trace, virtual clock, armed compile guard) and checks the
+degradation contracts machine-verifiably:
+
+- the run neither hangs nor crashes — every request ends ``done`` or
+  recorded-shed, the output file stays position-complete;
+- a retired replica's in-flight requests are requeued onto survivors and
+  COMPLETED, with output bytes identical to the no-fault run (per-row
+  beam independence makes a re-served request bit-exact);
+- requests shed by the poison quarantine hold an empty output line and a
+  recorded error; every position not shed matches the no-fault bytes;
+- zero post-warmup retraces with faults armed (faults act on the host
+  side only — no new program exists to compile).
+
+Modes:
+  --smoke     one seeded fault per site + a corrupt leg + a watchdog-hang
+              leg, each checked against the contracts above under the
+              armed compile guard. Exit nonzero on any violation — the
+              scripts/check.sh tier-1 leg.
+  (default)   measure throughput / shed-rate / retirement rows across
+              injected fault rates, write --out (the committed artifact
+              docs/CHAOS_BENCH_r01.jsonl), echo a final JSON line.
+
+Env knobs: FIRA_CHAOS_COMMITS (measure-mode corpus size, default 240),
+FIRA_CHAOS_RATES (default "0.0,0.05,0.2" per-event fire probabilities),
+FIRA_CHAOS_SEED (default 11), FIRA_CHAOS_SLOTS (default 8),
+FIRA_CHAOS_BATCH (default 6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+DEFAULT_OUT = os.path.join(REPO_ROOT, "docs", "CHAOS_BENCH_r01.jsonl")
+
+
+def _setup(n_commits: int, *, batch: int, slots: int, replicas: int = 1,
+           buckets=(), **cfg_kw):
+    """Synthetic corpus + tiny engine config + EOS-biased params (the
+    serve_bench recipe, chaos knobs riding on top)."""
+    import numpy as np
+
+    from fira_tpu.config import fira_tiny
+    from fira_tpu.data.batching import make_batch
+    from fira_tpu.data.dataset import FiraDataset
+    from fira_tpu.data.synthetic import write_corpus_dir
+    from fira_tpu.decode.beam import eos_biased_params
+    from fira_tpu.model.model import FiraModel
+    from fira_tpu.train.state import init_state
+
+    data_dir = tempfile.mkdtemp(prefix="fira_chaos_bench_")
+    write_corpus_dir(data_dir, n_commits=n_commits, seed=13)
+    cfg = fira_tiny(batch_size=8, test_batch_size=batch,
+                    decode_engine=True, engine_slots=slots * replicas,
+                    engine_replicas=replicas, buckets=buckets, **cfg_kw)
+    dataset = FiraDataset(data_dir, cfg)
+    cfg = dataset.cfg
+    split = dataset.splits["train"]
+    sample = make_batch(split, np.arange(min(batch, len(split))), cfg,
+                        batch_size=batch)
+    model = FiraModel(cfg)
+    params = eos_biased_params(init_state(model, cfg, sample).params,
+                               delta=4.0)
+    return dataset, cfg, model, params
+
+
+def _check_degraded_bytes(ref_lines, got_lines, records):
+    """Every position that was NOT recorded-shed (or corrupted) must hold
+    the no-fault line; shed positions must hold an empty line. Returns a
+    list of violations (empty = contract holds)."""
+    bad = []
+    if len(ref_lines) != len(got_lines):
+        return [f"line count {len(got_lines)} != no-fault {len(ref_lines)}"]
+    for rec in records:
+        pos = rec["position"]
+        if rec["status"] == "done":
+            continue  # checked in bulk below
+        if got_lines[pos] != "":
+            bad.append(f"shed position {pos} line is not empty")
+    shed = {r["position"] for r in records if r["status"] != "done"}
+    for pos, (a, b) in enumerate(zip(ref_lines, got_lines)):
+        if pos in shed:
+            continue
+        if a != b:
+            bad.append(f"completed position {pos} differs from no-fault")
+    return bad
+
+
+def smoke() -> int:
+    """One seeded fault per site through a fixed-trace virtual-clock
+    serve under the armed compile guard; contracts checked per leg."""
+    from fira_tpu.analysis import sanitizer
+    from fira_tpu.decode.runner import run_test
+    from fira_tpu.serve import poisson_times, serve_split
+
+    # 2 replicas so replica-level faults have survivors to degrade onto;
+    # bucketed so the declared program family (and its zero-retrace
+    # contract) is non-trivial
+    dataset, cfg, model, params = _setup(
+        40, batch=6, slots=6, replicas=2, buckets=((16, 400, 12),),
+        dispatch_watchdog_s=0.0, robust_retries=1, fault_hang_s=1.0)
+    n = len(dataset.splits["train"])
+    times = poisson_times(n, rate=0.5, seed=3)  # virtual-clock units
+    work = tempfile.mkdtemp(prefix="fira_chaos_smoke_")
+
+    drain = run_test(model, params, dataset, cfg,
+                     out_dir=os.path.join(work, "drain"), split="train")
+    ref_lines = open(drain["output_path"]).read().split("\n")
+
+    # (site, kind, rate, extra cfg) — rates/seeds chosen so each leg's
+    # fault actually FIRES on this fixed schedule (asserted below: a leg
+    # whose fault never fired proves nothing)
+    legs = [
+        # (site, kind, rate, seed, extra-cfg) — seeds picked so the fault
+        # FIRES mid-run on this fixed schedule (the deterministic draw is
+        # a pure function of (seed, site, event key))
+        ("feeder.assemble", "raise", 0.08, 7, {}),
+        ("feeder.device_put", "raise", 0.08, 8, {}),
+        ("engine.prefill", "raise", 0.15, 9, {}),
+        ("engine.step", "raise", 0.02, 18, {}),
+        ("engine.harvest", "raise", 0.02, 11, {}),
+        ("fleet.replica", "raise", 0.02, 2, {}),
+        ("serve.admit", "raise", 0.08, 13, {}),
+        ("feeder.assemble", "corrupt", 0.08, 7, {}),
+        ("engine.step", "hang", 0.02, 18, {"dispatch_watchdog_s": 0.25}),
+    ]
+    results = []
+    ok = True
+    for i, (site, kind, rate, seed, extra) in enumerate(legs):
+        from fira_tpu.robust import faults as faults_lib
+
+        c = cfg.replace(inject_faults=f"{site}:{kind}:{rate}:{seed}",
+                        **extra)
+        # build the injector HERE so the smoke can read fired_keys after
+        # the run (serve request tasks are single-row in split order, so
+        # a feeder-site fire key IS the affected split position)
+        inj = faults_lib.injector_from(c)
+        with sanitizer.sanitize(nans=False, infs=False) as guard:
+            m = serve_split(model, params, dataset, c, arrival_times=times,
+                            out_dir=os.path.join(work, f"leg{i}"),
+                            split="train", clock="virtual", guard=guard,
+                            faults=inj)
+            extra_compiles = guard.compiles_after_warmup()
+        sv = m["serve"]
+        got_lines = open(m["output_path"]).read().split("\n")
+        fired = sum(m.get("faults", {}).values())
+        accounted = (sv["completed"] + sv["shed_queue_full"]
+                     + sv["shed_deadline"] + sv["shed_error"])
+        if kind == "corrupt":
+            # blast-radius contract: ONLY the corrupted positions may
+            # differ from the no-fault bytes (per-row beam independence)
+            corrupted = set(inj.fired_keys.get(site, []))
+            bad = [f"non-corrupted position {pos} differs from no-fault"
+                   for pos, (a, b) in enumerate(zip(ref_lines, got_lines))
+                   if pos not in corrupted and a != b]
+        else:
+            bad = _check_degraded_bytes(ref_lines, got_lines,
+                                        m["request_records"])
+        replica_fault = site in ("engine.step", "engine.harvest",
+                                 "fleet.replica")
+        leg_ok = (fired > 0 and accounted == n and not bad
+                  and extra_compiles == 0
+                  and (not replica_fault or sv["replica_retirements"] >= 1)
+                  and len(got_lines) == len(ref_lines))
+        ok = ok and leg_ok
+        results.append({
+            "leg": f"{site}:{kind}", "rate": rate, "ok": leg_ok,
+            "fired": fired, "completed": sv["completed"],
+            "shed_error": sv["shed_error"],
+            "retirements": sv["replica_retirements"],
+            "requeued": sv["requeued_requests"],
+            "retries": sv["request_retries"],
+            "compiles_after_warmup": extra_compiles,
+            **({"byte_violations": bad[:3]} if bad else {}),
+        })
+    print(json.dumps({"smoke": "ok" if ok else "FAIL", "n_requests": n,
+                      "legs": results}), flush=True)
+    return 0 if ok else 1
+
+
+def measure(out_path: str) -> int:
+    """Throughput / shed-rate / retirement rows under injected fault
+    rates: the committed chaos record (docs/CHAOS_BENCH_r01.jsonl)."""
+    from fira_tpu.serve import poisson_times, serve_split
+
+    n_commits = int(os.environ.get("FIRA_CHAOS_COMMITS", "240"))
+    batch = int(os.environ.get("FIRA_CHAOS_BATCH", "6"))
+    slots = int(os.environ.get("FIRA_CHAOS_SLOTS", "8"))
+    seed = int(os.environ.get("FIRA_CHAOS_SEED", "11"))
+    rates = [float(r) for r in os.environ.get(
+        "FIRA_CHAOS_RATES", "0.0,0.05,0.2").split(",")]
+
+    dataset, cfg, model, params = _setup(
+        n_commits, batch=batch, slots=slots, replicas=2,
+        dispatch_watchdog_s=0.0, robust_retries=1)
+    n = len(dataset.splits["train"])
+    work = tempfile.mkdtemp(prefix="fira_chaos_out_")
+    # wall clock at a rate the serve path sustains; the interesting
+    # numbers are the DELTAS across fault rates, not the absolutes
+    offered = float(os.environ.get("FIRA_CHAOS_OFFERED_RPS", "150"))
+    times = poisson_times(n, offered, seed=seed)
+
+    # one untimed warm pass: first-use costs (text-cooking/BLEU imports,
+    # the serve path's own first touches) off the timed rows — the 0.0
+    # baseline row must not carry them or the fault-rate deltas invert
+    serve_split(model, params, dataset, cfg,
+                arrival_times=poisson_times(min(n, 24), offered, seed=seed),
+                out_dir=os.path.join(work, "warm"), split="train",
+                clock="wall")
+
+    scenarios = [("feeder.assemble", "raise"), ("engine.step", "raise")]
+    rows = []
+    for site, kind in scenarios:
+        for rate in rates:
+            c = (cfg if rate == 0.0 else
+                 cfg.replace(inject_faults=f"{site}:{kind}:{rate}:{seed}"))
+            t0 = time.perf_counter()
+            m = serve_split(model, params, dataset, c, arrival_times=times,
+                            out_dir=os.path.join(
+                                work, f"{site.replace('.', '_')}_{rate}"),
+                            split="train", clock="wall")
+            wall = time.perf_counter() - t0
+            sv = m["serve"]
+            rows.append({
+                "mode": "chaos_rate", "site": site, "kind": kind,
+                "rate": rate, "offered_rps": offered,
+                "n_requests": n, "wall_s": round(wall, 3),
+                "throughput_rps": sv["throughput_rps"],
+                "completed": sv["completed"],
+                "shed_error": sv["shed_error"],
+                "shed_frac": round(sv["shed_error"] / n, 4),
+                "retirements": sv["replica_retirements"],
+                "requeued": sv["requeued_requests"],
+                "retries": sv["request_retries"],
+                "fired": sum(m.get("faults", {}).values()),
+                "p50_e2e_s": sv["p50_e2e_s"], "p99_e2e_s": sv["p99_e2e_s"],
+                "host": "cpu-tiny (fira_tiny geometry; deltas across "
+                        "fault rates are the artifact, not absolutes)",
+            })
+
+    stamp = {"generated_by": "scripts/chaos_bench.py",
+             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    with open(out_path, "w") as f:
+        f.write(json.dumps(stamp) + "\n")
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    print(json.dumps({"rows": rows, "out": out_path}), flush=True)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="seeded fault at each site, contract-checked "
+                         "(scripts/check.sh tier-1 leg)")
+    ap.add_argument("--out", default=DEFAULT_OUT,
+                    help=f"JSONL record path (default {DEFAULT_OUT})")
+    args = ap.parse_args()
+
+    from fira_tpu.utils.backend_guard import force_cpu_backend
+
+    force_cpu_backend()
+    if args.smoke:
+        return smoke()
+    return measure(args.out)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,20 +10,23 @@ cd "$(dirname "$0")/.."
 echo "== firacheck: static JAX-hazard scan =="
 # fira_tpu/data/feeder.py, fira_tpu/data/buckets.py,
 # fira_tpu/data/grouping.py, fira_tpu/decode/engine.py,
-# fira_tpu/decode/paging.py, fira_tpu/parallel/fleet.py and
-# fira_tpu/serve/server.py are named explicitly (as well as being inside
-# the fira_tpu tree, which the CLI dedupes): the async input pipeline,
-# the bucket packer, the grouped dispatch scheduler, the slot-refill
-# decode engine, the paged-KV arena geometry/validation, the replicated
-# decode fleet and the arrival-timed serving loop are designated driver
-# modules (astutil._DRIVER_FILES) whose threaded/packing/refill/admission
-# loops MUST stay in the self-scan even if the directory arguments ever
+# fira_tpu/decode/paging.py, fira_tpu/parallel/fleet.py,
+# fira_tpu/serve/server.py, fira_tpu/robust/faults.py and
+# fira_tpu/robust/watchdog.py are named explicitly (as well as being
+# inside the fira_tpu tree, which the CLI dedupes): the async input
+# pipeline, the bucket packer, the grouped dispatch scheduler, the
+# slot-refill decode engine, the paged-KV arena geometry/validation, the
+# replicated decode fleet, the arrival-timed serving loop and the
+# fault-injection/watchdog machinery are designated driver modules
+# (astutil._DRIVER_FILES) whose threaded/packing/refill/admission loops
+# MUST stay in the self-scan even if the directory arguments ever
 # change.
 JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu fira_tpu/data/feeder.py fira_tpu/data/buckets.py \
     fira_tpu/data/grouping.py fira_tpu/decode/engine.py \
     fira_tpu/decode/paging.py fira_tpu/parallel/fleet.py \
-    fira_tpu/serve/server.py tests scripts \
+    fira_tpu/serve/server.py fira_tpu/robust/faults.py \
+    fira_tpu/robust/watchdog.py tests scripts \
     || exit $?
 
 echo "== multichip smoke: 2 virtual CPU devices (docs/MULTICHIP.md) =="
@@ -37,6 +40,15 @@ echo "== serve smoke: fixed-trace replay under the compile guard (docs/SERVING.m
 # replay through the slot engine under the armed compile guard — output
 # bytes must equal drain mode and zero post-warmup compiles must hold.
 JAX_PLATFORMS=cpu python scripts/serve_bench.py --smoke || exit $?
+
+echo "== chaos smoke: seeded fault at each site (docs/FAULTS.md) =="
+# The graceful-degradation contracts stay machine-enforced in tier-1:
+# one seeded fault per registered site (plus a corrupt leg and a
+# watchdog-hang leg) through a fixed-trace virtual-clock serve under the
+# armed compile guard — every run must terminate with every request done
+# or recorded-shed, unaffected output bytes equal to the no-fault run,
+# retirements/requeues recorded, and zero post-warmup compiles.
+JAX_PLATFORMS=cpu python scripts/chaos_bench.py --smoke || exit $?
 
 echo "== tier-1 pytest (ROADMAP.md verify, verbatim) =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

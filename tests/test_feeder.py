@@ -117,8 +117,13 @@ class TestFailure:
             raise KeyError("sync boom")
 
         feed = Feeder(iter([boom]), num_workers=0, put=False)
-        with pytest.raises(KeyError, match="sync boom"):
+        # wrapped with the task's identity (FeederTaskError, task 0); the
+        # original exception rides the cause chain and the message
+        from fira_tpu.data.feeder import FeederTaskError
+
+        with pytest.raises(FeederTaskError, match="sync boom") as ei:
             next(feed)
+        assert isinstance(ei.value.original, KeyError)
 
 
 class TestShutdown:
