@@ -143,6 +143,14 @@ rates through the serving loop, fira_tpu/robust (docs/FAULTS.md) — and
 folds its rows into this record; the full artifact lands in
 docs/CHAOS_BENCH_r01.jsonl. FIRA_BENCH_CHAOS_TIMEOUT caps the sweep,
 default 900 s),
+FIRA_BENCH_CACHE=1 (opt-in repeated-traffic leg: runs
+scripts/serve_bench.py --cache — prefix cache + in-flight dedup on vs
+off at seeded repeat rates {0, 0.3, 0.6}, hit rate /
+prefill-dispatches-saved / throughput / p50-p99 per row with on-vs-off
+bytes asserted identical (decode/prefix_cache.py, docs/DECODE_ENGINE.md
+"Prefix cache & dedup") — and folds its rows into this record; the full
+artifact lands in docs/CACHE_BENCH_r01.jsonl. FIRA_BENCH_CACHE_TIMEOUT
+caps the sweep, default 900 s),
 
 Composed leg — the production path going forward (ISSUE 4): the stacked
 knobs AND the auto bucket table together. One shuffled epoch plan of
@@ -789,7 +797,7 @@ def worker() -> None:
             print(f"multichip leg failed: {e!r}", file=sys.stderr)
             multichip = {"error": repr(e)}
 
-    def _script_rows_leg(name, script_name, timeout_env):
+    def _script_rows_leg(name, script_name, timeout_env, args=()):
         """Shared shape of the opt-in subprocess legs whose scripts emit
         one final JSON line with a ``rows`` list (serve_bench.py,
         chaos_bench.py): run it with a bounded timeout, fold the rows,
@@ -800,7 +808,7 @@ def worker() -> None:
                 os.path.dirname(os.path.abspath(__file__)),
                 "scripts", script_name)
             p = subprocess.run(
-                [sys.executable, script], text=True,
+                [sys.executable, script, *args], text=True,
                 timeout=float(os.environ.get(timeout_env, "900")),
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE)
             rec = _last_json_line(p.stdout or "")
@@ -832,6 +840,18 @@ def worker() -> None:
     if os.environ.get("FIRA_BENCH_CHAOS", "0") == "1":
         chaos = _script_rows_leg("chaos", "chaos_bench.py",
                                  "FIRA_BENCH_CHAOS_TIMEOUT")
+
+    # (i) CACHE leg (opt-in: FIRA_BENCH_CACHE=1): cross-request reuse
+    # under repeated traffic — scripts/serve_bench.py --cache serves
+    # seeded repeat-rate/Zipf request mixes with the prefix cache +
+    # in-flight dedup on vs off and records hit rate, prefill dispatches
+    # saved, dedup fan-out, and throughput/p50/p99 per repeat rate
+    # (decode/prefix_cache.py; docs/DECODE_ENGINE.md).
+    cache = None
+    if os.environ.get("FIRA_BENCH_CACHE", "0") == "1":
+        cache = _script_rows_leg("cache", "serve_bench.py",
+                                 "FIRA_BENCH_CACHE_TIMEOUT",
+                                 args=("--cache",))
 
     step_time = dt_e2e / steps_per_window
     compute_step_time = dt_compute / steps_per_window
@@ -890,6 +910,10 @@ def worker() -> None:
         # chaos / graceful-degradation rows (FIRA_BENCH_CHAOS=1; the full
         # artifact is docs/CHAOS_BENCH_r01.jsonl — scripts/chaos_bench.py)
         **({"chaos": chaos} if chaos else {}),
+        # repeated-traffic prefix-cache rows (FIRA_BENCH_CACHE=1; the
+        # full artifact is docs/CACHE_BENCH_r01.jsonl —
+        # scripts/serve_bench.py --cache)
+        **({"prefix_cache": cache} if cache else {}),
         "feed_stall_frac_sync_assembly": sync_info["feed_stall_frac"],
         "value_e2e_sync_assembly": round(
             batch_size / (dt_sync / steps_per_window) / n_chips, 2),

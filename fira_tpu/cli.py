@@ -147,6 +147,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "reservation) at that budget. The longer-target "
                         "door: raise the config tar_len and declare the "
                         "common case as a bucket")
+    p.add_argument("--prefix-cache", default=None, choices=["on", "off"],
+                   help="cross-request prefix cache + in-flight dedup "
+                        "(decode/prefix_cache.py; docs/DECODE_ENGINE.md "
+                        "'Prefix cache & dedup'): 'on' content-addresses "
+                        "each request's prefill artifacts by a keyed "
+                        "digest of its packed payload — a byte-identical "
+                        "repeat seats from cache without dispatching "
+                        "prefill, and an identical IN-FLIGHT request "
+                        "coalesces onto the existing seat with fan-out "
+                        "delivery (one decode, N output positions). "
+                        "Bit-exact vs 'off' (tested); hits/misses/"
+                        "evictions, dedup fan-out, prefill dispatches "
+                        "saved, and HBM bytes saved are metered. Default: "
+                        "ON for `serve`, off for `test` (engine path "
+                        "required)")
+    p.add_argument("--prefix-cache-entries", type=int, default=None,
+                   metavar="N",
+                   help="prefix-cache LRU capacity in cached request "
+                        "entries, per engine replica (default 256; must "
+                        "be >= 1 when the cache is on — validated at "
+                        "parse time, exit 2)")
+    p.add_argument("--prefix-cache-bytes", type=int, default=None,
+                   metavar="B",
+                   help="prefix-cache host-memory budget in bytes, per "
+                        "engine replica: entries evict LRU-first until "
+                        "payload bytes fit (artifact payloads are MBs "
+                        "per entry at production geometry). 0/unset = "
+                        "unbounded (the entry cap is the only bound); "
+                        "must be >= 0 — validated at parse time, exit 2")
     p.add_argument("--serve-rate", type=float, default=None, metavar="RPS",
                    help="serve: offered load in requests/second for the "
                         "open-loop Poisson arrival generator; required "
@@ -189,7 +218,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "'site:kind:rate:seed[,...]' arming named "
                         "injection points (sites: feeder.assemble, "
                         "feeder.device_put, engine.prefill, engine.step, "
-                        "engine.harvest, fleet.replica, serve.admit; "
+                        "engine.harvest, fleet.replica, serve.admit, "
+                        "cache.lookup; "
                         "kinds: raise | hang | corrupt). Deterministic "
                         "given the seed — chaos runs replay exactly; "
                         "validated at parse time, exit 2. Off by default "
@@ -339,9 +369,20 @@ def _resolve_cfg(args):
         overrides["decode_tar_buckets"] = True
     # serve runs ON the slot engine: the serving loop drives the engine's
     # steppable scheduler pieces, so the engine path (and its parse-time
-    # fleet/paging validation) is implied by the command itself
+    # fleet/paging validation) is implied by the command itself. The
+    # prefix cache + in-flight dedup default ON for serve — repeated
+    # traffic is the serving regime they exist for — with --prefix-cache
+    # off as the byte-identical equivalence comparator.
     if args.command == "serve":
         overrides["decode_engine"] = True
+        if args.prefix_cache is None:
+            overrides["prefix_cache"] = True
+    if args.prefix_cache is not None:
+        overrides["prefix_cache"] = args.prefix_cache == "on"
+    if args.prefix_cache_entries is not None:
+        overrides["prefix_cache_entries"] = args.prefix_cache_entries
+    if args.prefix_cache_bytes is not None:
+        overrides["prefix_cache_bytes"] = args.prefix_cache_bytes
     if args.serve_rate is not None:
         overrides["serve_rate"] = args.serve_rate
     if args.serve_prefill_budget is not None:
@@ -481,6 +522,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from fira_tpu.decode.paging import paging_errors
 
         errs += paging_errors(cfg)
+    # prefix-cache knob admission (engine path required, LRU capacity
+    # >= 1) — same exit-2 contract, decode/paging.prefix_cache_errors;
+    # runs UNGATED so `--prefix-cache on` without --engine gets the
+    # named message instead of a silent no-op
+    from fira_tpu.decode.paging import prefix_cache_errors
+
+    errs += prefix_cache_errors(cfg)
     if args.command == "serve":
         # serving knob admission (offered rate, prefill budget vs slots,
         # deadline floor, queue bound) — same exit-2 contract,

@@ -211,6 +211,37 @@ class FiraConfig:
     # both. Off (default): tar pinned full on every decode bucket, the
     # byte-identical historical behavior.
     decode_tar_buckets: bool = False
+    # --- cross-request prefix cache + in-flight dedup (decode/prefix_cache
+    # .py; docs/DECODE_ENGINE.md "Prefix cache & dedup") ---
+    # True arms BOTH reuse mechanisms on the engine path: (a) the
+    # content-addressed prefill-result cache — each request's prefill
+    # artifacts (encoder output / per-beam cross K/V / copy-head src
+    # projections) are keyed by a keyed-blake2b digest of its packed
+    # payload, and a repeat request seats from the cached artifacts
+    # WITHOUT dispatching prefill — and (b) in-flight dedup: a request
+    # byte-identical to one already admitted coalesces onto the existing
+    # seat and is delivered by fan-out at harvest (one decode, N output
+    # positions, each request keeping its own arrival/deadline/TTFT
+    # stamps). Both are host-side (no new program geometry: zero
+    # post-warmup retraces hold with the cache armed) and bit-exact: a
+    # cache-hit or deduped response is byte-identical to its cold run
+    # (tests/test_prefix_cache.py). False (default) keeps the historical
+    # byte-identical behavior — the equivalence comparator. `cli serve`
+    # defaults this ON (--prefix-cache off opts out); drain decode opts
+    # in via --prefix-cache on.
+    prefix_cache: bool = False
+    # LRU capacity of the prefill-result cache, in cached request entries
+    # (per engine replica — caches are per-chip like the KV arena they
+    # feed). Must be >= 1 when prefix_cache is on (validated at parse
+    # time, exit 2 — decode/paging.prefix_cache_errors).
+    prefix_cache_entries: int = 256
+    # Optional HOST-memory budget for the cache in bytes, per replica
+    # (entry payloads are per-beam cross K/V + src projections — MBs per
+    # entry at production geometry, so an entry-count bound alone can
+    # pin gigabytes of host RAM). 0 = unbounded (the entry cap is the
+    # only bound); otherwise LRU entries evict until total payload bytes
+    # fit. Must be >= 0 (validated at parse time, exit 2).
+    prefix_cache_bytes: int = 0
     # Replicated-engine decode fleet (parallel/fleet.py; docs/MULTICHIP.md):
     # N SlotEngine replicas — one per device/data-mesh slice, each with its
     # own per-chip KV arena and compiled program set — pull packed chunks
@@ -254,7 +285,8 @@ class FiraConfig:
     # Seeded fault-injection spec "site:kind:rate:seed[,...]" arming named
     # injection points along the request path (sites: feeder.assemble,
     # feeder.device_put, engine.prefill, engine.step, engine.harvest,
-    # fleet.replica, serve.admit; kinds: raise | hang | corrupt).
+    # fleet.replica, serve.admit, cache.lookup; kinds: raise | hang |
+    # corrupt).
     # Deterministic given the seed — every chaos run replays exactly —
     # and validated at parse time (robust.faults.robust_errors, CLI
     # exit 2). "" = off: the injector is None and every site check is one

@@ -315,14 +315,19 @@ def packed_plan(split: ProcessedSplit, cfg: FiraConfig, *,
 
 def bucketed_assembly_tasks(split: ProcessedSplit, plan: Plan,
                             cfg: FiraConfig, *,
-                            batch_size: Optional[int] = None
-                            ) -> Iterator:
+                            batch_size: Optional[int] = None,
+                            stamp=None) -> Iterator:
     """One ``make_batch(geom=...)`` task per plan entry, for the async
     Feeder. Each batch carries two HOST-ONLY fields (stripped before
     device_put, data/feeder.py): ``_positions`` — the split-local sample
     index per row (-1 on pad rows), so drivers can restore corpus output
     order after packing reordered the stream — and ``_tag`` — the bucket's
-    geometry tag for per-bucket compile-guard labels."""
+    geometry tag for per-bucket compile-guard labels.
+
+    ``stamp``: optional post-assembly hook run WORKER-side, like
+    feeder.assembly_tasks' — the decode drivers pass
+    decode.prefix_cache.stamp_digests under ``cfg.prefix_cache`` so
+    content digests never hash on the scheduler thread."""
     from fira_tpu.data.batching import make_batch
 
     bs = batch_size or cfg.batch_size
@@ -334,7 +339,7 @@ def bucketed_assembly_tasks(split: ProcessedSplit, plan: Plan,
             positions[: len(chunk)] = chunk
             batch["_positions"] = positions
             batch["_tag"] = geom_tag(geom)
-            return batch
+            return stamp(batch) if stamp is not None else batch
         # a failing worker's FeederTaskError names the poisoned chunk:
         # split positions + bucket geometry (data/feeder.task_note)
         from fira_tpu.data.feeder import task_note

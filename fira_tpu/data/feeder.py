@@ -424,16 +424,24 @@ def task_note(positions, *, geom_tag: Optional[str] = None,
     return "; ".join(parts)
 
 
-def assembly_tasks(split, chunks, cfg, *, batch_size: Optional[int] = None
+def assembly_tasks(split, chunks, cfg, *, batch_size: Optional[int] = None,
+                   stamp: Optional[Callable[[Batch], Batch]] = None
                    ) -> Iterator[Task]:
     """One ``make_batch`` task per index chunk (see
     data.batching.epoch_index_chunks for the order contract). Each task
     carries a ``note`` naming its split positions, so a failing worker's
-    FeederTaskError identifies the poisoned chunk."""
+    FeederTaskError identifies the poisoned chunk.
+
+    ``stamp``: optional post-assembly hook applied WORKER-side (it runs
+    inside the task, on the pool thread) — the decode drivers pass
+    decode.prefix_cache.stamp_digests here when ``cfg.prefix_cache`` is
+    armed, so payload content digests are computed off the scheduler
+    thread like the rest of batch assembly."""
     from fira_tpu.data.batching import make_batch
 
     for chunk in chunks:
-        task = (lambda c=chunk: make_batch(split, c, cfg,
-                                           batch_size=batch_size))
+        def task(c=chunk):
+            b = make_batch(split, c, cfg, batch_size=batch_size)
+            return stamp(b) if stamp is not None else b
         task.note = task_note(chunk, site="assembly_tasks")
         yield task

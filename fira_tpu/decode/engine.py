@@ -96,6 +96,33 @@ bit-exactness) by construction instead of re-implementing them.
 ``device``/``tag`` pin a replica to its own chip and suffix its guard
 labels (``engine_step[r0]``), keeping the one-compile-per-label contract
 honest when N replicas each compile their own program set.
+
+Cross-request reuse (``cfg.prefix_cache``, default off — decode/
+prefix_cache.py, docs/DECODE_ENGINE.md "Prefix cache & dedup"): ``admit``
+content-addresses each valid row by a keyed blake2b digest of its packed
+payload and applies two composable mechanisms before dispatching
+prefill. (a) IN-FLIGHT DEDUP: a row byte-identical to one already
+admitted on THIS engine coalesces onto the existing seat as a FOLLOWER —
+no seat, no blocks, no prefill; ``harvest`` fans the leader's settled
+(tokens, probs) out to every follower's own output position (one decode,
+N commits). (b) PREFILL-RESULT CACHE: when every remaining row's
+artifacts are cached, the staged chunk is assembled host-side from the
+cached rows and seated WITHOUT a prefill dispatch (``prefills_saved``);
+a chunk that does dispatch fills the cache with host copies of its rows.
+Both are host-side lookups — no new program geometry exists, so the
+zero-post-warmup-retrace contract holds with the cache armed — and both
+are bit-exact: a cache-hit or coalesced response is byte-identical to
+its cold run (tests/test_prefix_cache.py).
+
+The paged block allocator is REFCOUNTED (the free list is a deque —
+O(1) grants, the old ``list.pop(0)`` walk was O(n) per block): a grant
+acquires each block at refcount 1, harvest/retire RELEASE grants (a
+block returns to ``_free_blocks`` only at refcount zero) rather than
+scribbling the free list wholesale, and double-grant/double-release are
+asserted impossible (:meth:`SlotEngine.allocator_invariants`, pinned in
+tier-1). Blocks whose seat serves a coalesced fan-out group are the
+SHARED blocks of the reuse story — one grant serving N requests — and
+their high-water mark is metered (``shared_block_peak``).
 """
 
 from __future__ import annotations
@@ -111,6 +138,7 @@ import numpy as np
 from fira_tpu.analysis.sanitizer import program_label
 from fira_tpu.config import FiraConfig
 from fira_tpu.decode import paging
+from fira_tpu.decode import prefix_cache as prefix_cache_lib
 from fira_tpu.decode.beam import (_init_beam, _select, _select_factored,
                                   step_valid_mask)
 from fira_tpu.model.model import FiraModel
@@ -149,6 +177,27 @@ class EngineStats:
     harvest_row_reads: int = 0   # settled-slot rows read back individually
     harvest_bytes_read: int = 0  # token/prob bytes actually copied D2H
     harvest_bytes_saved: int = 0  # vs the historical full-arena readback
+    # cross-request reuse accounting (decode/prefix_cache.py; all zero
+    # when cfg.prefix_cache is off — the byte-identical comparator)
+    cache_hits: int = 0          # seated rows served from the prefill cache
+    cache_misses: int = 0        # seated rows that paid a prefill dispatch
+    #                              with the cache armed
+    cache_evictions: int = 0     # LRU entries evicted for capacity
+    cache_integrity_drops: int = 0  # entries dropped on checksum mismatch
+    prefills_saved: int = 0      # admitted chunks that dispatched NO
+    #                              prefill (all rows cache-hit or coalesced)
+    cache_hbm_bytes_saved: int = 0  # prefill-artifact bytes served from
+    #                              cache instead of materialized by dispatch
+    dedup_fanout: int = 0        # requests coalesced onto an existing seat
+    #                              (delivered by fan-out at harvest)
+    shared_block_peak: int = 0   # high-water mark of paged blocks whose
+    #                              seat serves a coalesced fan-out group
+
+    @property
+    def cache_hit_rate(self) -> float:
+        """Fraction of seated rows served from the prefill cache."""
+        total = self.cache_hits + self.cache_misses
+        return self.cache_hits / total if total else 0.0
 
     @property
     def slot_occupancy(self) -> float:
@@ -195,6 +244,15 @@ class EngineStats:
             "harvest_row_reads": self.harvest_row_reads,
             "harvest_bytes_read": self.harvest_bytes_read,
             "harvest_bytes_saved": self.harvest_bytes_saved,
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "cache_hit_rate": round(self.cache_hit_rate, 4),
+            "cache_evictions": self.cache_evictions,
+            "cache_integrity_drops": self.cache_integrity_drops,
+            "prefills_saved": self.prefills_saved,
+            "cache_hbm_bytes_saved": self.cache_hbm_bytes_saved,
+            "dedup_fanout": self.dedup_fanout,
+            "shared_block_peak": self.shared_block_peak,
         }
 
 
@@ -282,6 +340,15 @@ class SlotEngine:
                     f"kv_pool_blocks {self._pool_blocks} < table width "
                     f"{self._table_width}: one full-tar sample must fit "
                     f"an empty pool or admission livelocks")
+        # cross-request prefill cache (decode/prefix_cache.py): one LRU
+        # PER ENGINE — a fleet replica's cache is per-chip like its KV
+        # arena (cached artifacts re-enter via device_put onto this
+        # engine's own device). None = off, zero hot-path overhead.
+        self._cache = None
+        if cfg.prefix_cache:
+            self._cache = prefix_cache_lib.PrefixCache(
+                cfg.prefix_cache_entries,
+                max_bytes=cfg.prefix_cache_bytes, faults=faults)
         self.stats = EngineStats(slots=self.slots)
         self._state = None
         self._prefill = jax.jit(self._prefill_fn)
@@ -695,13 +762,134 @@ class SlotEngine:
         exactly as before the scheduler was made steppable)."""
         self._staged: "collections.deque[_Staged]" = collections.deque()
         self._staged_rows = 0
-        self._free: List[int] = list(range(self.slots))
+        self._free: "collections.deque[int]" = collections.deque(
+            range(self.slots))
         self._busy: Dict[int, Tuple[int, Dict, int]] = {}
-        # paged-KV block allocator: the free list and the per-slot grant
-        # map reset with the scheduler; the POOL CONTENTS do not — stale
-        # block values are exactly masked, never read (beam.step_valid_mask)
-        self._free_blocks: List[int] = list(range(self._pool_blocks))
+        # paged-KV block allocator: the free list (a deque — O(1) grants)
+        # and the per-slot grant map reset with the scheduler; the POOL
+        # CONTENTS do not — stale block values are exactly masked, never
+        # read (beam.step_valid_mask). Grants are refcounted: a block
+        # returns to _free_blocks only at refcount zero (_release_blocks),
+        # and double-grant/double-release assert (allocator_invariants).
+        self._free_blocks: "collections.deque[int]" = collections.deque(
+            range(self._pool_blocks))
+        self._block_refs: Dict[int, int] = {}
         self._slot_blocks: Dict[int, List[int]] = {}
+        # in-flight dedup maps (cfg.prefix_cache): digest -> leader
+        # position for every admitted-but-unharvested row, and leader
+        # position -> coalesced followers awaiting fan-out delivery
+        self._inflight: Dict[str, int] = {}
+        self._row_digest: Dict[int, str] = {}
+        self._followers: Dict[int, List[Tuple[int, Dict, int]]] = {}
+        # positions whose seat serves a fan-out group COALESCED ABOVE the
+        # engine (the serve loop's fleet-global dedup keeps its followers
+        # in the loop, not here) — stamped by the loop each round purely
+        # so shared_block_peak meters those seats' grants too
+        self.shared_positions: set = set()
+        # cache miss-fills DEFERRED to the harvest boundary: admit only
+        # schedules the D2H (copy_to_host_async) and parks the chunk
+        # here; harvest — the engine's designated sync point — drains it.
+        # Admission therefore never blocks on a prefill readback, and the
+        # store-later window is covered by dedup (the rows' digests sit
+        # in _inflight until the same harvest that drains their fill).
+        self._pending_fills: List[Tuple[List[Tuple[int, str]], Dict]] = []
+
+    # --- refcounted paged-block allocator -------------------------------
+
+    def _acquire_blocks(self, need: int) -> List[int]:
+        """Grant ``need`` blocks off the free deque at refcount 1. The
+        caller checked availability (head-of-line admission); a granted
+        block being granted again is an allocator bug, asserted here."""
+        grant: List[int] = []
+        for _ in range(need):
+            b = self._free_blocks.popleft()
+            assert self._block_refs.get(b, 0) == 0, \
+                f"block {b} granted while already held (double grant)"
+            self._block_refs[b] = 1
+            grant.append(b)
+        return grant
+
+    def _release_blocks(self, blocks) -> None:
+        """Decrement each block's refcount; a block returns to the free
+        deque only at refcount ZERO. Today every grant is exclusive
+        (refcount 1 — fan-out sharing is SEAT-level: one grant serves
+        the whole coalesced group, so no second holder exists), so the
+        refcounts are the double-grant/double-release guard and the
+        forward surface for true multi-holder mappings. Release paths:
+        harvest (seat settled), retire (engine dead); a shed follower
+        detaches without holding blocks at all."""
+        for b in blocks:
+            n = self._block_refs.get(b, 0)
+            assert n > 0, f"block {b} released while not granted"
+            if n == 1:
+                del self._block_refs[b]
+                self._free_blocks.append(b)
+            else:
+                self._block_refs[b] = n - 1
+
+    def allocator_invariants(self) -> List[str]:
+        """Machine-checkable allocator health (tier-1-pinned): every pool
+        block is exactly free or granted, no block is granted twice, and
+        refcounts agree with the grant map. Empty list = healthy."""
+        errs: List[str] = []
+        free = list(self._free_blocks)
+        if len(set(free)) != len(free):
+            errs.append("duplicate blocks on the free list")
+        granted: Dict[int, int] = {}
+        for slot, blocks in self._slot_blocks.items():
+            for b in blocks:
+                granted[b] = granted.get(b, 0) + 1
+        for b, holders in granted.items():
+            refs = self._block_refs.get(b, 0)
+            if refs < holders:
+                errs.append(f"block {b} held by {holders} grant(s) but "
+                            f"refcount {refs}")
+        for b, refs in self._block_refs.items():
+            if refs < 1:
+                errs.append(f"block {b} carries refcount {refs} <= 0")
+        overlap = set(granted) & set(free)
+        if overlap:
+            errs.append(f"blocks {sorted(overlap)[:4]} both free and granted")
+        if len(free) + len(self._block_refs) != self._pool_blocks:
+            errs.append(
+                f"free ({len(free)}) + granted ({len(self._block_refs)}) "
+                f"!= pool ({self._pool_blocks})")
+        return errs
+
+    # --- prefix-cache surface -------------------------------------------
+
+    def _artifact_fields(self) -> Tuple[str, ...]:
+        return ((prefix_cache_lib.ARTIFACT_FIELDS_KV + ("cache_seed",))
+                if self.cfg.beam_kv_cache
+                else prefix_cache_lib.ARTIFACT_FIELDS_NOKV)
+
+    def _drain_pending_fills(self) -> None:
+        """Materialize deferred miss-fills (the D2H was scheduled async
+        at admit) and store each row by its content digest. Runs at the
+        harvest sync boundary only."""
+        while self._pending_fills:
+            fills, chunk = self._pending_fills.pop(0)
+            chunk_host = {}
+            for f in self._artifact_fields():
+                chunk_host[f] = np.asarray(jax.device_get(chunk[f]))  # firacheck: allow[HOST-SYNC] deferred prefill-cache miss-fill draining at the harvest sync boundary; the D2H itself was scheduled async at admit (copy_to_host_async), so this materialization is the designated host copy, not a mid-admission stall
+            entries = prefix_cache_lib.extract_payloads(
+                chunk_host, [r for r, _d in fills], self.cfg.beam_size)
+            for r, d in fills:
+                self.stats.cache_evictions += self._cache.put(d, entries[r])
+
+    def cache_contains(self, digest) -> bool:
+        """Non-mutating cache probe (the serve loop partitions admission
+        batches into hit/miss chunks with this — serve/server.py)."""
+        return self._cache is not None and self._cache.contains(digest)
+
+    def cache_clear(self) -> None:
+        """Drop every cached prefill entry (bench hygiene: a warm pass
+        must not hand the timed window its hits)."""
+        if self._cache is not None:
+            self._cache.clear()
+
+    def cache_len(self) -> int:
+        return len(self._cache) if self._cache is not None else 0
 
     def wants_input(self) -> bool:
         """Prefill-ahead policy: keep ``engine_prefill_depth`` chunks
@@ -725,10 +913,13 @@ class SlotEngine:
 
     def pending_positions(self) -> List[int]:
         """Every admitted-but-unfinished request position: seated in a
-        slot OR staged for refill — exactly the set a retirement must
-        requeue onto surviving replicas."""
+        slot, staged for refill, OR coalesced onto a seat as a dedup
+        follower — exactly the set a retirement must requeue onto
+        surviving replicas."""
         pos = [pid for (pid, _host, _row) in self._busy.values()]
         pos += [pid for e in self._staged for (_r, pid) in e.rows]
+        pos += [fpos for fl in self._followers.values()
+                for (fpos, _h, _r) in fl]
         return pos
 
     def retire(self) -> List[Dict]:
@@ -751,6 +942,14 @@ class SlotEngine:
         for entry in self._staged:
             hosts[id(entry.host)] = entry.host
             groups.setdefault(id(entry.host), []).extend(entry.rows)
+        # dedup followers are owed requests too: each re-admits from its
+        # OWN host batch (byte-identical payload), so a survivor serves
+        # it bit-exactly whether it re-coalesces there or seats fresh —
+        # re-admission payloads survive dedup instead of being lost
+        for _leader, fl in sorted(self._followers.items()):
+            for fpos, fhost, frow in fl:
+                hosts[id(fhost)] = fhost
+                groups.setdefault(id(fhost), []).append((frow, fpos))
         payloads: List[Dict] = []
         for hid, rows in groups.items():
             host = hosts[hid]
@@ -769,9 +968,17 @@ class SlotEngine:
         self._busy.clear()
         self._staged.clear()
         self._staged_rows = 0
-        self._free = list(range(self.slots))
-        self._free_blocks = list(range(self._pool_blocks))
-        self._slot_blocks.clear()
+        self._free = collections.deque(range(self.slots))
+        # RELEASE every seat's grant through the refcounted path (never
+        # scribble the free list wholesale): shared blocks drop to zero
+        # holders here, and the invariant checks stay meaningful on a
+        # retired engine (the chaos leak check reads exactly this)
+        for slot in list(self._slot_blocks):
+            self._release_blocks(self._slot_blocks.pop(slot))
+        self._inflight.clear()
+        self._row_digest.clear()
+        self._followers.clear()
+        self._pending_fills.clear()   # a dead replica fills no cache
         return payloads
 
     def admit(self, host: Dict, index: int, device_batch=None) -> None:
@@ -780,44 +987,150 @@ class SlotEngine:
         None (or an engine pinned to its own device — a fleet replica
         cannot use a chunk committed elsewhere) re-ships the host batch,
         stripping the "_"-prefixed host-only fields exactly like the
-        feeder does."""
+        feeder does.
+
+        With ``cfg.prefix_cache`` armed, two host-side reuse passes run
+        first (decode/prefix_cache.py): rows byte-identical to a request
+        already in flight COALESCE onto the existing seat (fan-out at
+        harvest), and a chunk whose remaining rows are ALL cached seats
+        from the cache without dispatching prefill. Dedup/cache maps
+        commit only AFTER staging succeeds, so a prefill that raises (or
+        a watchdog abandonment) leaves no orphaned followers or phantom
+        in-flight digests behind."""
         if self._faults is not None:
             self._faults.check("engine.prefill")
         if self.retired:
             return  # abandoned by a watchdog mid-dispatch; engine is dead
-        if device_batch is None or self.device is not None:
-            wire = {k: v for k, v in host.items() if not k.startswith("_")}
-            device_batch = jax.device_put(wire, self.device)
-        chunk = self._prefill(self.params, device_batch)
-        if self.retired:
-            # the watchdog expired while the prefill ran and the replica
-            # was retired: its requests were requeued elsewhere — staging
-            # them here too would decode them twice
-            return
-        self._guard_step(self.label(PREFILL_KIND, host.get("_tag")))
-        self._ensure_state(chunk)
-        self.stats.prefills += 1
         positions = host.get("_positions")  # bucketed stream only
         valid = host["valid"]
-        rows: "collections.deque[Tuple[int, int]]" = collections.deque()
         C = valid.shape[0]
+        row_ids: List[Tuple[int, int]] = []
         for r in range(C):
             if not valid[r]:
                 continue
             pos_id = (int(positions[r]) if positions is not None  # firacheck: allow[HOST-SYNC] _positions is a host-only numpy field (feeder strips it from the wire); no device value exists here
                       else index * C + r)
-            rows.append((r, pos_id))
-        if rows:
-            # the chunk's tar budget: its bucket geometry is visible in
-            # the packed msg width (make_batch slices msg to the bucket's
-            # tar) — under decode_tar_buckets that budget caps generation
-            # and sizes the paged block reservation; otherwise every slot
-            # gets the full arena budget, the historical behavior
-            limit = (int(host["msg"].shape[1]) if self.cfg.decode_tar_buckets
-                     else self.cfg.tar_len)
-            self._staged.append(_Staged(chunk=chunk, host=host, rows=rows,
-                                        limit=limit))
-            self._staged_rows += len(rows)
+            row_ids.append((r, pos_id))
+        digests = None
+        if self._cache is not None and row_ids:
+            digests = host.get("_digests")  # worker-side stamp when present
+            if digests is None:
+                digests = prefix_cache_lib.payload_digests(host)
+        # PASS 1 — in-flight dedup (pure reads; maps commit below): rows
+        # whose digest matches an admitted-but-unharvested row become
+        # followers of that seat instead of taking one of their own
+        followers: List[Tuple[int, int, int]] = []  # (leader_pos, pos, row)
+        seat_rows: List[Tuple[int, int]] = []
+        if digests is not None:
+            batch_leaders: Dict[str, int] = {}
+            for r, pos_id in row_ids:
+                d = digests[r]
+                leader = None
+                if d is not None:
+                    leader = self._inflight.get(d)
+                    if leader is None:
+                        leader = batch_leaders.get(d)
+                if leader is not None:
+                    followers.append((leader, pos_id, r))
+                else:
+                    if d is not None:
+                        batch_leaders[d] = pos_id
+                    seat_rows.append((r, pos_id))
+        else:
+            seat_rows = row_ids
+
+        # PASS 2 — prefill-result cache: all-hit chunks assemble host-side
+        # from cached artifacts (one device_put, ZERO compiled programs —
+        # the insert sees the exact pytree the prefill would have produced)
+        chunk = None
+        payloads: Dict[int, Dict] = {}
+        st = self.stats
+        if seat_rows and self._cache is not None and all(
+                self._cache.contains(digests[r]) for r, _p in seat_rows):
+            for r, _pos in seat_rows:
+                payload, outcome = self._cache.take(digests[r])
+                if outcome == "integrity_drop":
+                    st.cache_integrity_drops += 1
+                if payload is None:   # fault_miss / integrity_drop:
+                    payloads.clear()  # the whole chunk re-prefills — a
+                    break             # cache fault is a miss, never a
+                #                       wrong answer
+                payloads[r] = payload
+        if seat_rows and len(payloads) == len(seat_rows) and payloads:
+            st.cache_hits += len(payloads)
+            st.cache_hbm_bytes_saved += sum(
+                prefix_cache_lib.payload_nbytes(p) for p in payloads.values())
+            st.prefills_saved += 1
+            chunk = jax.device_put(
+                prefix_cache_lib.build_chunk(payloads, C,
+                                             self.cfg.beam_size),
+                self.device)
+            self._ensure_state(chunk)
+        elif seat_rows:
+            if device_batch is None or self.device is not None:
+                wire = {k: v for k, v in host.items()
+                        if not k.startswith("_")}
+                device_batch = jax.device_put(wire, self.device)
+            chunk = self._prefill(self.params, device_batch)
+            if self.retired:
+                # the watchdog expired while the prefill ran and the
+                # replica was retired: its requests were requeued
+                # elsewhere — staging them here too would decode them
+                # twice (and no dedup/cache map was touched yet)
+                return
+            self._guard_step(self.label(PREFILL_KIND, host.get("_tag")))
+            self._ensure_state(chunk)
+            st.prefills += 1
+            if self._cache is not None:
+                # miss-fill, DEFERRED: schedule the artifact D2H now
+                # (async — overlaps the decode steps) and store at the
+                # next harvest, the designated sync boundary. Rows whose
+                # entries existed but could not serve (this chunk
+                # dispatched) count as misses and are refreshed there.
+                st.cache_misses += len(seat_rows)
+                fills = [(r, digests[r]) for r, _pos in seat_rows
+                         if digests[r] is not None]
+                if fills:
+                    for f in self._artifact_fields():
+                        a = chunk[f]
+                        if hasattr(a, "copy_to_host_async"):
+                            a.copy_to_host_async()
+                    self._pending_fills.append((fills, chunk))
+
+        # COMMIT — maps and staging mutate only on a fully-successful
+        # path, and only on a LIVE engine: the cache-hit branch above
+        # dispatches nothing but still crossed a device_put a watchdog
+        # could have abandoned this thread inside — committing here
+        # would mutate _staged/_inflight/_followers under a concurrent
+        # retire() (the same race the miss path's post-dispatch re-check
+        # guards)
+        if self.retired:
+            return
+        if followers:
+            for leader, pos_id, r in followers:
+                self._followers.setdefault(leader, []).append(
+                    (pos_id, host, r))
+            st.dedup_fanout += len(followers)
+            if not seat_rows:
+                st.prefills_saved += 1  # whole chunk coalesced: no dispatch
+        if not seat_rows:
+            return
+        if digests is not None:
+            for r, pos_id in seat_rows:
+                if digests[r] is not None:
+                    self._inflight[digests[r]] = pos_id
+                    self._row_digest[pos_id] = digests[r]
+        # the chunk's tar budget: its bucket geometry is visible in
+        # the packed msg width (make_batch slices msg to the bucket's
+        # tar) — under decode_tar_buckets that budget caps generation
+        # and sizes the paged block reservation; otherwise every slot
+        # gets the full arena budget, the historical behavior
+        limit = (int(host["msg"].shape[1]) if self.cfg.decode_tar_buckets
+                 else self.cfg.tar_len)
+        self._staged.append(_Staged(
+            chunk=chunk, host=host,
+            rows=collections.deque(seat_rows), limit=limit))
+        self._staged_rows += len(seat_rows)
 
     def refill(self, refill_order: str = "fifo") -> None:
         """Insert staged rows into every free slot (one insert dispatch
@@ -847,11 +1160,11 @@ class SlotEngine:
             while not self.retired and self._free and entry.rows and (
                     not self._paged or len(self._free_blocks) >= need):
                 r, pos_id = entry.rows.popleft()
-                slot = (self._free.pop(0) if refill_order == "fifo"
+                slot = (self._free.popleft() if refill_order == "fifo"
                         else self._free.pop())
                 slot_ids[r] = slot
                 if self._paged:
-                    grant = [self._free_blocks.pop(0) for _ in range(need)]
+                    grant = self._acquire_blocks(need)
                     block_rows[r, :need] = grant
                     self._slot_blocks[slot] = grant
                 self._busy[slot] = (pos_id, entry.host, r)
@@ -893,6 +1206,17 @@ class SlotEngine:
             used = self._pool_blocks - len(self._free_blocks)
             st.block_steps += used
             st.peak_blocks = max(st.peak_blocks, used)
+            if self._followers or self.shared_positions:
+                # shared blocks: grants whose seat is serving a coalesced
+                # fan-out group — one block set, N requests' worth of
+                # decode (the dedup half of the HBM-reuse story; groups
+                # coalesced by the serve loop arrive via shared_positions)
+                fan = self.shared_positions
+                shared = sum(
+                    len(self._slot_blocks.get(s, ()))
+                    for s, (pid, _h, _r) in self._busy.items()
+                    if pid in self._followers or pid in fan)
+                st.shared_block_peak = max(st.shared_block_peak, shared)
 
     def harvest(self) -> List[EngineItem]:
         """Read back the dispatched step's done mask and return every
@@ -910,6 +1234,12 @@ class SlotEngine:
             self._faults.check("engine.harvest")
         if self.retired:
             return []  # abandoned by a watchdog; engine is dead
+        if self._cache is not None and self._pending_fills:
+            # commit deferred miss-fills BEFORE any dedup bookkeeping is
+            # popped below: a digest leaves _inflight only once its
+            # entry is stored, so a repeat arriving next round finds
+            # either the in-flight leader or the cached artifacts
+            self._drain_pending_fills()
         stats = self.stats
         stats.occupied_slot_steps += int(np.array(
             jax.device_get(self._pending_occ)))
@@ -943,15 +1273,29 @@ class SlotEngine:
             for s, toks_np, probs_np in reads:
                 pos_id, host, r = self._busy.pop(s)
                 self._free.append(s)
-                # the slot's block grant returns WHOLE — contents stay as
-                # the slot left them (unmapped, not zeroed; the next
-                # grantee's validity mask makes them an exact 0.0)
-                self._free_blocks.extend(self._slot_blocks.pop(s, ()))
+                # the slot's block grant is RELEASED through the
+                # refcounted allocator — contents stay as the slot left
+                # them (unmapped, not zeroed; the next grantee's validity
+                # mask makes them an exact 0.0), and a block returns to
+                # the free deque only at refcount zero
+                self._release_blocks(self._slot_blocks.pop(s, ()))
                 stats.commits += 1
                 stats.harvest_row_reads += 1
                 stats.harvest_bytes_read += row_bytes
                 items.append(EngineItem(position=pos_id, host=host, row=r,
                                         tokens=toks_np, probs=probs_np))
+                # dedup fan-out delivery: every follower coalesced onto
+                # this seat gets the leader's settled beams at its OWN
+                # output position (one decode, N commits — byte-identical
+                # by construction: same digest => same payload bytes)
+                d = self._row_digest.pop(pos_id, None)
+                if d is not None:
+                    self._inflight.pop(d, None)
+                for fpos, fhost, frow in self._followers.pop(pos_id, ()):
+                    stats.commits += 1
+                    items.append(EngineItem(position=fpos, host=fhost,
+                                            row=frow, tokens=toks_np,
+                                            probs=probs_np))
             stats.harvest_bytes_saved += full_bytes - row_bytes * len(reads)
         return items
 

@@ -148,6 +148,34 @@ def paging_errors(cfg: FiraConfig) -> List[str]:
     return errs
 
 
+def prefix_cache_errors(cfg: FiraConfig) -> List[str]:
+    """Parse-time prefix-cache knob admission check (docs/DECODE_ENGINE.md
+    "Prefix cache & dedup"): one named-knob message per violation, CLI
+    exit 2 — the cache twin of :func:`paging_errors`. The cache seats
+    cached prefill artifacts into ENGINE slots, so it requires the engine
+    path; its LRU needs at least one entry of capacity."""
+    if not cfg.prefix_cache:
+        return []
+    errs: List[str] = []
+    if not cfg.decode_engine:
+        errs.append(
+            "prefix_cache requires the decode engine (--engine, --perf "
+            "production, or cli serve): cached prefill artifacts are "
+            "seated into engine slots — the batched beam has no seat to "
+            "map them into")
+    if cfg.prefix_cache_entries < 1:
+        errs.append(
+            f"prefix_cache_entries {cfg.prefix_cache_entries} must be "
+            f">= 1 cached prefill entry when prefix_cache is on (the LRU "
+            f"needs capacity to hold at least one artifact set)")
+    if cfg.prefix_cache_bytes < 0:
+        errs.append(
+            f"prefix_cache_bytes {cfg.prefix_cache_bytes} must be >= 0 "
+            f"(0 = unbounded host bytes; otherwise the per-replica LRU "
+            f"evicts until its payload bytes fit the budget)")
+    return errs
+
+
 def block_bytes(cfg: FiraConfig, block_size: int, itemsize: int) -> int:
     """HBM bytes of ONE pool block pair (K and V): all layers x all beam
     lanes x heads x block positions x head dim."""

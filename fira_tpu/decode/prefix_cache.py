@@ -1,0 +1,307 @@
+"""Cross-request prefix cache: content-addressed prefill reuse.
+
+At serving scale traffic REPEATS — CI re-runs, monorepo bots, and client
+retries send byte-identical diffs — yet every request pays a full
+prefill: the encoder pass, the per-beam cross K/V, and the copy-head
+source projections (the static, read-only-during-decode half of a seat's
+state). vLLM's block-sharing design (PAPERS.md "Continuous batching /
+inference serving", SOSP '23) showed content-addressed read-only reuse is
+the biggest serve-throughput lever short of new hardware; this module is
+that lever under this stack's architecture (docs/DECODE_ENGINE.md
+"Prefix cache & dedup"):
+
+- **Content address**: a request's identity is a KEYED blake2b digest of
+  its packed wire payload — every non-host-only field's bytes, dtype, and
+  shape (the keyed-digest idiom of robust/faults.py: no process-global
+  hashing, deterministic across processes and thread schedules). The
+  digest is computed HOST-side, worker-side where a feeder assembles the
+  payload (data/feeder.py ``stamp=``, serve/server._request_tasks), and
+  on demand in the engine otherwise.
+- **Prefill-result cache** (:class:`PrefixCache`): digest -> the per-row
+  prefill artifacts, held as HOST numpy copies (one D2H per cache-filling
+  prefill — prefill is already a dispatch boundary). On a hit the engine
+  assembles a staged chunk from cached rows with plain numpy + ONE
+  ``device_put`` and seats it WITHOUT dispatching prefill: no compiled
+  program runs, so the program family — and the zero-post-warmup-retrace
+  contract — is untouched by construction. Capacity-bounded LRU
+  (``cfg.prefix_cache_entries``); while a fault injector arms the
+  ``cache.lookup`` site, every entry carries a content checksum verified
+  at lookup, so a corrupt-injected read is DETECTED and the entry
+  dropped (a miss, never a wrong answer — the chaos legs pin exactly
+  this; unarmed, entries are trusted process memory like every other
+  host buffer, and hashing megabytes of artifacts per hit would tax the
+  scheduler thread the cache exists to relieve).
+- **In-flight dedup** rides the same digests: byte-identical requests
+  already admitted coalesce onto the existing seat with fan-out delivery
+  at harvest (one decode, N output positions). The maps live in the
+  engine (per replica) and the serve loop (fleet-global);
+  this module only provides the addressing.
+
+Equivalence contract: a cache-hit seat decodes from BIT-identical
+artifact values (``device_put(device_get(x))`` round-trips exactly), so
+its (tokens, probs) — hence its output bytes — equal the cold run's
+(tests/test_prefix_cache.py, all four kv-cache x factored-topk modes,
+paged and unpaged).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+# the keyed-digest discipline of robust/faults.py: never Python hash()
+# (salted per process), always a keyed blake2b over explicit bytes
+_DIGEST_KEY = b"fira-prefix-cache-v1"
+
+# the per-row prefill artifact fields, by engine mode (the chunk keys of
+# decode/engine.SlotEngine._prefill_fn minus the scalar dtype marker)
+ARTIFACT_FIELDS_KV = ("src_mask", "diff", "sub_token",
+                      "cross_k", "cross_v", "src_proj")
+ARTIFACT_FIELDS_NOKV = ("src_mask", "diff", "sub_token", "states")
+
+
+def _digest_arrays(items: Iterable[Tuple[str, np.ndarray]]) -> str:
+    """Keyed blake2b over (name, dtype, shape, bytes) of each array —
+    shape/dtype are hashed so a bucket geometry change can never alias a
+    content match across geometries."""
+    h = hashlib.blake2b(key=_DIGEST_KEY, digest_size=16)
+    for name, arr in items:
+        a = np.ascontiguousarray(arr)
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def payload_digests(host: Dict) -> List[Optional[str]]:
+    """One content digest per VALID row of a packed host batch (None for
+    pad rows): every wire field (host-only "_" keys and the positional
+    ``valid`` mask excluded) contributes its row's bytes. Two rows digest
+    equal iff their packed payloads are byte-identical at the same
+    geometry — the dedup/cache identity."""
+    valid = np.asarray(host["valid"], dtype=bool)
+    fields = sorted(k for k in host if not k.startswith("_") and k != "valid")
+    out: List[Optional[str]] = []
+    for r in range(valid.shape[0]):
+        out.append(_digest_arrays((f, np.asarray(host[f])[r])  # firacheck: allow[HOST-SYNC] packed host batches are numpy already (the feeder assembles on host); digesting their bytes is pure host work, no device value exists here
+                                  for f in fields) if valid[r] else None)
+    return out
+
+
+def stamp_digests(host: Dict) -> Dict:
+    """Attach ``_digests`` (host-only metadata, stripped from the wire by
+    the feeder like every "_" key) to a packed batch — the worker-side
+    stamping hook (data/feeder.assembly_tasks ``stamp=``,
+    serve/server._request_tasks), so the scheduler thread never pays the
+    hashing."""
+    host["_digests"] = payload_digests(host)
+    return host
+
+
+def payload_nbytes(payload: Dict[str, np.ndarray]) -> int:
+    return sum(int(np.asarray(v).nbytes) for v in payload.values())
+
+
+def extract_payloads(chunk_host: Dict[str, np.ndarray], rows: List[int],
+                     beam: int) -> Dict[int, Dict[str, np.ndarray]]:
+    """Slice one prefilled chunk's HOST copy into per-row cache payloads.
+    Row r owns beam lanes ``r*K..(r+1)*K`` of the K-repeated arrays
+    (cross_k/cross_v on axis 1, src_proj/states on axis 0) — and those K
+    lanes are byte-identical by construction (the prefill's
+    ``jnp.repeat``), so the payload stores ONE lane and :func:`build_chunk`
+    re-repeats it: 1/K the host RAM, hashing, and byte-budget charge for
+    a bit-identical rebuild. ``seed`` records the cache-seed dtype so a
+    rebuilt chunk reproduces the prefill pytree exactly."""
+    K = int(beam)
+    kv = "cross_k" in chunk_host
+    out: Dict[int, Dict[str, np.ndarray]] = {}
+    for r in rows:
+        p: Dict[str, np.ndarray] = {
+            "src_mask": np.ascontiguousarray(chunk_host["src_mask"][r]),
+            "diff": np.ascontiguousarray(chunk_host["diff"][r]),
+            "sub_token": np.ascontiguousarray(chunk_host["sub_token"][r]),
+        }
+        if kv:
+            p["cross_k"] = np.ascontiguousarray(
+                chunk_host["cross_k"][:, r * K:r * K + 1])
+            p["cross_v"] = np.ascontiguousarray(
+                chunk_host["cross_v"][:, r * K:r * K + 1])
+            p["src_proj"] = np.ascontiguousarray(
+                chunk_host["src_proj"][r * K:r * K + 1])
+            p["seed"] = np.zeros((), chunk_host["cache_seed"].dtype)
+        else:
+            p["states"] = np.ascontiguousarray(
+                chunk_host["states"][r * K:r * K + 1])
+        out[r] = p
+    return out
+
+
+def build_chunk(payloads: Dict[int, Dict[str, np.ndarray]], batch_rows: int,
+                beam: int) -> Dict[str, np.ndarray]:
+    """Assemble a staged-chunk pytree from cached per-row payloads: the
+    EXACT key set, shapes, and dtypes of the prefill program's output for
+    this geometry (so the insert program sees the same pytree structure
+    it was traced with — a cache hit can never retrace). Rows without a
+    payload (pad rows, coalesced rows) stay zero; the insert scatter
+    drops them via the sentinel slot id, so their values are never read."""
+    C, K = int(batch_rows), int(beam)
+    any_p = next(iter(payloads.values()))
+    kv = "cross_k" in any_p
+    out: Dict[str, np.ndarray] = {}
+    for f in ("src_mask", "diff", "sub_token"):
+        a = any_p[f]
+        out[f] = np.zeros((C,) + a.shape, a.dtype)
+    if kv:
+        ck = any_p["cross_k"]          # (L, 1, ...) — one stored lane
+        L = ck.shape[0]
+        for f in ("cross_k", "cross_v"):
+            out[f] = np.zeros((L, C * K) + ck.shape[2:], ck.dtype)
+        sp = any_p["src_proj"]         # (1, ...)
+        out["src_proj"] = np.zeros((C * K,) + sp.shape[1:], sp.dtype)
+        out["cache_seed"] = np.zeros((), any_p["seed"].dtype)
+    else:
+        st = any_p["states"]           # (1, ...)
+        out["states"] = np.zeros((C * K,) + st.shape[1:], st.dtype)
+    for r, p in payloads.items():
+        for f in ("src_mask", "diff", "sub_token"):
+            out[f][r] = p[f]
+        # re-repeat the single stored lane across the K beam slots —
+        # bitwise what the prefill's jnp.repeat produced
+        if kv:
+            out["cross_k"][:, r * K:(r + 1) * K] = np.repeat(
+                p["cross_k"], K, axis=1)
+            out["cross_v"][:, r * K:(r + 1) * K] = np.repeat(
+                p["cross_v"], K, axis=1)
+            out["src_proj"][r * K:(r + 1) * K] = np.repeat(
+                p["src_proj"], K, axis=0)
+        else:
+            out["states"][r * K:(r + 1) * K] = np.repeat(
+                p["states"], K, axis=0)
+    return out
+
+
+@dataclasses.dataclass
+class _Entry:
+    payload: Dict[str, np.ndarray]
+    checksum: Optional[str]  # keyed digest of the payload content —
+    #                          computed/verified only while a fault
+    #                          injector arms cache.lookup (the only
+    #                          writer between put and take IS that
+    #                          injector's corrupt; hashing megabytes of
+    #                          artifacts per hit on the scheduler thread
+    #                          would tax exactly the path the cache
+    #                          exists to make cheap)
+    nbytes: int
+
+
+class PrefixCache:
+    """Capacity-bounded LRU of per-row prefill artifacts, content-
+    addressed by payload digest. Host-side only: no device memory, no
+    compiled programs, no locks (the scheduler thread owns it — one
+    instance per engine replica, per-chip like the arena it feeds).
+
+    ``take`` is the metered lookup: LRU-touches on a hit, and — while an
+    injector arms the ``cache.lookup`` site — runs the fault check (a
+    raise demotes the lookup to a miss) and verifies the entry's content
+    checksum (a corrupt-injected read is dropped, never served).
+    ``contains`` is the non-mutating probe the serve loop partitions
+    batches with.
+    """
+
+    def __init__(self, entries: int, *, max_bytes: int = 0, faults=None):
+        if int(entries) < 1:
+            raise ValueError(
+                f"prefix cache needs >= 1 entry of capacity, got {entries}")
+        if int(max_bytes) < 0:
+            raise ValueError(
+                f"prefix cache byte budget must be >= 0, got {max_bytes}")
+        self.capacity = int(entries)
+        # optional host-RAM bound: artifact payloads are MBs per entry at
+        # production geometry, so the entry cap alone can pin gigabytes
+        self.max_bytes = int(max_bytes)
+        self._nbytes = 0
+        self._lru: "collections.OrderedDict[str, _Entry]" = \
+            collections.OrderedDict()
+        self._faults = faults
+        self._lookups = 0   # deterministic event key for the fault site
+
+    def _integrity(self) -> bool:
+        """Content checksums are maintained exactly while the
+        ``cache.lookup`` fault site is armed — corrupt-injection is the
+        one writer between put and take, and the chaos contract is that
+        its scramble is DETECTED and dropped, never served."""
+        return self._faults is not None and self._faults.armed(
+            "cache.lookup")
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def contains(self, digest: Optional[str]) -> bool:
+        return digest is not None and digest in self._lru
+
+    def take(self, digest: str
+             ) -> Tuple[Optional[Dict[str, np.ndarray]], str]:
+        """(payload, outcome) — outcome one of ``hit`` / ``miss`` /
+        ``fault_miss`` (injected lookup raise, absorbed here: a cache
+        fault must never become a wrong answer or a shed request) /
+        ``integrity_drop`` (content checksum mismatch: the entry is
+        evicted and the caller re-prefills)."""
+        entry = self._lru.get(digest)
+        if entry is None:
+            return None, "miss"
+        payload = entry.payload
+        if self._integrity():
+            self._lookups += 1
+            try:
+                self._faults.check("cache.lookup", key=self._lookups)
+            except Exception:
+                return None, "fault_miss"
+            payload = self._faults.corrupt("cache.lookup", self._lookups,
+                                           payload)
+            if (entry.checksum is not None
+                    and _digest_arrays(sorted(payload.items()))
+                    != entry.checksum):
+                del self._lru[digest]
+                self._nbytes -= entry.nbytes
+                return None, "integrity_drop"
+        self._lru.move_to_end(digest)
+        return payload, "hit"
+
+    def put(self, digest: str, payload: Dict[str, np.ndarray]) -> int:
+        """Insert/refresh one entry; returns how many LRU entries were
+        evicted to make room (the eviction meter). Eviction honors both
+        bounds: the entry cap AND, when ``max_bytes`` is set, the host
+        byte budget (an over-budget entry alone still lives — the cache
+        degrades to capacity one, never refuses to serve)."""
+        old = self._lru.get(digest)
+        if old is not None:
+            self._nbytes -= old.nbytes
+        entry = _Entry(
+            payload=payload,
+            checksum=(_digest_arrays(sorted(payload.items()))
+                      if self._integrity() else None),
+            nbytes=payload_nbytes(payload))
+        self._lru[digest] = entry
+        self._lru.move_to_end(digest)
+        self._nbytes += entry.nbytes
+        evicted = 0
+        while len(self._lru) > self.capacity or (
+                self.max_bytes and self._nbytes > self.max_bytes
+                and len(self._lru) > 1):
+            _d, e = self._lru.popitem(last=False)
+            self._nbytes -= e.nbytes
+            evicted += 1
+        return evicted
+
+    def clear(self) -> None:
+        self._lru.clear()
+        self._nbytes = 0

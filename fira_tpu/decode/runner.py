@@ -87,6 +87,14 @@ def _decode_tasks(data, cfg: FiraConfig):
     """The packed decode stream: (tasks, decode bucket table or None).
     Shared by both decode paths — the engine prefills EXACTLY the batches
     the batched beam would dispatch."""
+    stamp = None
+    if cfg.prefix_cache:
+        # content digests computed worker-side with the rest of assembly
+        # (bucketed and unbucketed streams alike — the engine's on-demand
+        # fallback exists only for streams that bypass these task
+        # builders)
+        from fira_tpu.decode.prefix_cache import stamp_digests
+        stamp = stamp_digests
     if cfg.buckets:
         table = buckets_lib.decode_table(cfg)
         # tar-bucketed decode assigns by reference-message extent (the
@@ -97,12 +105,13 @@ def _decode_tasks(data, cfg: FiraConfig):
                                        table=table,
                                        use_msg=cfg.decode_tar_buckets)
         tasks = buckets_lib.bucketed_assembly_tasks(
-            data, plan, cfg, batch_size=cfg.test_batch_size)
+            data, plan, cfg, batch_size=cfg.test_batch_size, stamp=stamp)
         return tasks, table
     chunks = epoch_index_chunks(len(data), cfg,
                                 batch_size=cfg.test_batch_size)
     return assembly_tasks(data, chunks, cfg,
-                          batch_size=cfg.test_batch_size), None
+                          batch_size=cfg.test_batch_size,
+                          stamp=stamp), None
 
 
 def run_test(model: FiraModel, params, dataset: FiraDataset,

@@ -37,6 +37,20 @@ replica compiles its own program set (per-device executables); the
 declared family is the union over replicas (:meth:`EngineFleet.labels`)
 and still closes at one compile per label.
 
+Cross-request reuse (``cfg.prefix_cache`` — decode/prefix_cache.py):
+each replica owns a PER-CHIP prefix cache and in-flight dedup map,
+exactly like its per-chip KV arena (cached artifacts re-enter via
+``device_put`` onto the owning replica's device, so no cross-chip
+traffic exists to coordinate). Dedup therefore coalesces within a
+replica in drain mode (the serve loop's admission-time dedup,
+serve/server.py, is the fleet-GLOBAL layer); output bytes stay invariant
+either way because a coalesced delivery is byte-identical to a fresh
+decode of the same payload. Retirement RELEASES a dead replica's shared
+block grants through the refcounted allocator and folds its coalesced
+followers into the re-admission payloads — requeued requests survive
+dedup (re-coalescing or seating fresh on a survivor, both bit-exact)
+instead of being lost or decoded twice.
+
 Graceful degradation (docs/FAULTS.md): a replica whose dispatch raises —
 or exceeds ``cfg.dispatch_watchdog_s`` wall seconds and is abandoned on
 its watchdog thread — is RETIRED: removed from the service rotation, its
@@ -131,6 +145,21 @@ class FleetStats:
             "harvest_row_reads": tot("harvest_row_reads"),
             "harvest_bytes_read": tot("harvest_bytes_read"),
             "harvest_bytes_saved": tot("harvest_bytes_saved"),
+            # cross-request reuse accounting (decode/prefix_cache.py):
+            # caches are per-chip, so counts total across replicas and
+            # the hit rate is the fleet-wide served-from-cache fraction
+            "cache_hits": tot("cache_hits"),
+            "cache_misses": tot("cache_misses"),
+            "cache_hit_rate": round(
+                tot("cache_hits") / (tot("cache_hits")
+                                     + tot("cache_misses")), 4)
+            if tot("cache_hits") + tot("cache_misses") else 0.0,
+            "cache_evictions": tot("cache_evictions"),
+            "cache_integrity_drops": tot("cache_integrity_drops"),
+            "prefills_saved": tot("prefills_saved"),
+            "cache_hbm_bytes_saved": tot("cache_hbm_bytes_saved"),
+            "dedup_fanout": tot("dedup_fanout"),
+            "shared_block_peak": tot("shared_block_peak"),
             # fleet-wide mean fraction of slots doing real beam work
             "slot_occupancy": round(
                 tot("occupied_slot_steps") / steps_x_slots, 4

@@ -47,11 +47,18 @@ SITES = (
     "engine.harvest",     # the done-mask readback + sliced row gather
     "fleet.replica",      # one replica's whole service round
     "serve.admit",        # a request's admission into the serve queue
+    "cache.lookup",       # a prefix-cache lookup (decode/prefix_cache.py):
+    #                       raise => absorbed as a MISS (re-prefill, never a
+    #                       wrong answer); corrupt => the read payload is
+    #                       scrambled, the entry's content checksum catches
+    #                       it, and the entry is dropped
 )
 KINDS = ("raise", "hang", "corrupt")
-# corrupt scrambles a HOST payload in place; only the assembly site owns
-# one (every other site is a dispatch boundary with nothing host-mutable)
-CORRUPT_SITES = ("feeder.assemble",)
+# corrupt scrambles a HOST payload in place; only the sites that own a
+# host payload qualify (every other site is a dispatch boundary with
+# nothing host-mutable): batch assembly, and the prefix-cache read path
+# (whose checksum must catch the scramble — docs/FAULTS.md)
+CORRUPT_SITES = ("feeder.assemble", "cache.lookup")
 
 
 class InjectedFault(RuntimeError):

@@ -16,7 +16,14 @@ engine replicas exactly like parallel/fleet.py:
    arrival that finds it full is SHED immediately — rejection recorded,
    never a hang). Request payloads are pre-assembled ahead of time by the
    async Feeder (one single-row ``make_batch`` task per request, split
-   order), so admission never blocks on host assembly.
+   order), so admission never blocks on host assembly. With
+   ``cfg.prefix_cache`` armed, an arrival byte-identical to a request
+   already in flight (same worker-stamped content digest —
+   decode/prefix_cache.py) COALESCES onto that leader instead of taking
+   a queue slot: one decode, N output positions at the leader's harvest,
+   each request keeping its own arrival/deadline/TTFT stamps. A shed
+   follower detaches without killing the leader's seat; a shed leader
+   hands its group to the oldest surviving follower (promotion).
 2. **shed deadlines** — queued requests older than
    ``cfg.serve_deadline_steps`` step dispatches are shed (a request that
    exhausted its whole deadline without being seated cannot answer in
@@ -80,6 +87,18 @@ from fira_tpu.robust.watchdog import WatchdogTimeout, run_with_watchdog
 # this many scheduler rounds (plus once at startup and once on abort),
 # so a SIGKILL at any point leaves a recent, valid-JSON snapshot
 SNAPSHOT_EVERY_ROUNDS = 16
+
+# prefix-cache miss micro-batching window (rounds): with the cache ON,
+# cache hits admit for free and drain the queue fast, so the misses left
+# behind would otherwise dispatch as fragmentary prefill batches — the
+# dispatches the cache exists to save. Once the cache is actually
+# serving hits (repeated traffic; cold streams keep legacy admission),
+# a partial miss group WAITS (returned to the queue head) until it
+# fills, its head has waited this many step-dispatch rounds, or the
+# claiming replica would otherwise idle — a bounded dynamic-batching
+# delay, recorded honestly in the latency stamps. Cache off: never
+# holds (byte-identical legacy admission).
+MISS_HOLD_ROUNDS = 16
 
 
 # --------------------------------------------------------------------------
@@ -193,6 +212,11 @@ class RequestRecord:
     error: Optional[str] = None     # recorded failure when shed_error
     retries: int = 0                # assembly/admission/prefill retries paid
     requeues: int = 0               # times re-queued off a retired replica
+    # in-flight dedup (docs/DECODE_ENGINE.md "Prefix cache & dedup"): set
+    # when this request coalesced onto a byte-identical leader's seat —
+    # it is delivered by fan-out at the leader's harvest, keeping its OWN
+    # arrival/deadline/TTFT stamps (None for leaders and cache-off runs)
+    coalesced_into: Optional[int] = None
 
     @property
     def queue_wait_s(self) -> float:
@@ -231,6 +255,12 @@ class ServeStats:
     shed_error: int = 0
     retirements: List[Dict] = dataclasses.field(default_factory=list)
     requeues: int = 0
+    # in-flight dedup accounting (cfg.prefix_cache): requests coalesced
+    # onto a byte-identical leader's seat, how many fan-out groups
+    # delivered, and the largest group (leader + followers)
+    dedup_coalesced: int = 0
+    dedup_groups: int = 0
+    dedup_fanout_max: int = 0
 
     def summary(self) -> Dict:
         done = [r for r in self.records if r.status == "done"]
@@ -251,6 +281,9 @@ class ServeStats:
             "requeued_requests": self.requeues,
             "request_retries": sum(r.retries for r in self.records),
             "deadline_missed": sum(r.deadline_missed for r in done),
+            "dedup_coalesced": self.dedup_coalesced,
+            "dedup_groups": self.dedup_groups,
+            "dedup_fanout_max": self.dedup_fanout_max,
             "rounds": self.rounds,
             "admits": self.admits,
             "max_admits_per_round": self.max_admits_per_round,
@@ -271,6 +304,8 @@ class _Queued:
     record: RequestRecord
     host: Dict      # the request's single-row assembled batch
     bucket: int     # decode-table index (0 when unbucketed)
+    digest: Optional[str] = None  # content digest (cfg.prefix_cache;
+    #                               worker-stamped in _request_tasks)
 
 
 # --------------------------------------------------------------------------
@@ -312,6 +347,17 @@ class ServeLoop:
         self._arr_idx = 0
         self._rr = 0   # admission round-robin start (load balance)
         self._queue: "collections.deque[_Queued]" = collections.deque()
+        # fleet-GLOBAL in-flight dedup (cfg.prefix_cache): digest ->
+        # leader position for every non-final enqueued request, the
+        # reverse map for cleanup, leader position -> coalesced follower
+        # entries awaiting fan-out delivery, and followers promoted to
+        # leader when their leader shed (drained into the queue outside
+        # any deque walk — _drain_promotions)
+        self._dedup_on = bool(cfg.prefix_cache)
+        self._leaders: Dict[str, int] = {}
+        self._leader_digest: Dict[int, str] = {}
+        self._followers: Dict[int, List[_Queued]] = {}
+        self._promoted: List[_Queued] = []
         # single-row payloads of every taken-but-unfinished request, by
         # position: the requeue source when a replica retires mid-flight
         self._payloads: Dict[int, _Queued] = {}
@@ -337,12 +383,50 @@ class ServeLoop:
             rec = self.stats.records[i]
             rec.arrival_round = self.stats.rounds
             rec.retries += int(item.retries)  # firacheck: allow[HOST-SYNC] FedBatch.retries is a host int counter stamped by the feeder worker; no device value exists here
+            digest = None
+            if self._dedup_on and item.host is not None:
+                dl = item.host.get("_digests")
+                digest = dl[0] if dl else None
             if item.error is not None:
                 # poison-request quarantine: the request's assembly raised
                 # (and its feeder-side retries were spent) — shed with the
                 # error recorded; its output position holds an empty line
                 rec.error = str(item.error)
                 self._shed(rec, "shed_error")
+            elif digest is not None and digest in self._leaders:
+                # in-flight dedup: a byte-identical request is already
+                # queued/staged/seated — COALESCE onto that leader's seat
+                # instead of taking a queue slot. A coalesced request
+                # consumes no seat capacity, but its payload is real host
+                # memory pinned until the leader harvests, so the queue
+                # cap still bounds each fan-out GROUP: a retry storm of
+                # one hot digest sheds past-cap followers exactly like
+                # any other flood (backpressure survives dedup).
+                # Delivered by fan-out at the leader's harvest; keeps
+                # its OWN arrival/deadline/TTFT stamps.
+                leader = self._leaders[digest]
+                if self._cap and len(self._followers.get(leader, [])) \
+                        >= self._cap:
+                    self._shed(rec, "shed_queue_full")
+                else:
+                    lrec = self.stats.records[leader]
+                    bucket = (int(self._assignment[i])  # firacheck: allow[HOST-SYNC] host numpy bucket-assignment array (data/buckets.assign_buckets) — admission runs on host index data only, never device values
+                              if self._assignment is not None else 0)
+                    e = _Queued(rec, item.host, bucket, digest=digest)
+                    self._followers.setdefault(leader, []).append(e)
+                    rec.coalesced_into = leader
+                    rec.status = "queued"
+                    if lrec.status in ("staged", "seated"):
+                        # the leader's prefill/seat already happened: the
+                        # follower inherits those milestones at coalesce
+                        # time
+                        rec.admit_t = now
+                        rec.status = "staged"
+                    if lrec.status == "seated":
+                        rec.seat_t = now
+                        rec.status = "seated"
+                        self._awaiting_first_step.append(rec)
+                    self.stats.dedup_coalesced += 1
             elif self._cap and len(self._queue) >= self._cap:
                 self._shed(rec, "shed_queue_full")
             elif not self._admit_gate(rec):
@@ -351,7 +435,11 @@ class ServeLoop:
                 rec.status = "queued"
                 bucket = (int(self._assignment[i])  # firacheck: allow[HOST-SYNC] host numpy bucket-assignment array (data/buckets.assign_buckets) — admission runs on host index data only, never device values
                           if self._assignment is not None else 0)
-                self._queue.append(_Queued(rec, item.host, bucket))
+                if digest is not None:
+                    self._leaders[digest] = rec.position
+                    self._leader_digest[rec.position] = digest
+                self._queue.append(_Queued(rec, item.host, bucket,
+                                           digest=digest))
             self._arr_idx += 1
         self.stats.peak_queue_depth = max(self.stats.peak_queue_depth,
                                           len(self._queue))
@@ -398,10 +486,58 @@ class ServeLoop:
             self.stats.shed_error += 1
         self._final += 1
         self._payloads.pop(rec.position, None)
+        # a shed FOLLOWER detaches from its leader's fan-out group — the
+        # leader's seat is untouched (the dedup/shed contract)
+        if rec.coalesced_into is not None:
+            fl = self._followers.get(rec.coalesced_into)
+            if fl:
+                self._followers[rec.coalesced_into] = [
+                    e for e in fl if e.record is not rec]
+        # a shed LEADER hands its group to the oldest surviving follower:
+        # the promotee re-enters the queue (via _drain_promotions — never
+        # mid-walk of the deque) with its OWN arrival/deadline stamps and
+        # its own byte-identical payload, and the remaining followers
+        # re-point at it
+        d = self._leader_digest.pop(rec.position, None)
+        if d is not None:
+            self._leaders.pop(d, None)
+            fl = self._followers.pop(rec.position, [])
+            if fl:
+                head, rest = fl[0], fl[1:]
+                head.record.coalesced_into = None
+                self._leaders[d] = head.record.position
+                self._leader_digest[head.record.position] = d
+                for e in rest:
+                    e.record.coalesced_into = head.record.position
+                if rest:
+                    self._followers[head.record.position] = rest
+                self._promoted.append(head)
         self.shed_cb(rec)
 
+    def _drain_promotions(self) -> None:
+        """Enqueue followers promoted to leader by a leader shed. Runs
+        OUTSIDE any queue walk (a shed mid-walk must not mutate the deque
+        being iterated). A promotee whose own deadline already lapsed is
+        shed here — which may promote the next follower in turn, so the
+        loop runs until the promotion chain settles."""
+        while self._promoted:
+            e = self._promoted.pop(0)
+            rec = e.record
+            if self._deadline and (self.stats.rounds - rec.arrival_round
+                                   >= self._deadline):
+                self._shed(rec, "shed_deadline")
+                continue
+            rec.status = "queued"
+            rec.admit_t = rec.seat_t = rec.first_step_t = math.nan
+            self._queue.append(e)
+
     def _shed_deadlines(self) -> None:
-        """Drop queued requests whose whole deadline elapsed un-seated."""
+        """Drop queued requests whose whole deadline elapsed un-seated.
+        Dedup followers mirror queued semantics until their leader seats:
+        a follower past its OWN deadline detaches (the leader's seat is
+        never killed); once the leader is seated the group rides to
+        harvest with late completions flagged per follower, exactly like
+        any seated request."""
         if not self._deadline:
             return
         keep: "collections.deque[_Queued]" = collections.deque()
@@ -411,23 +547,68 @@ class ServeLoop:
             else:
                 keep.append(e)
         self._queue = keep
+        self._drain_promotions()
+        for leader, fl in list(self._followers.items()):
+            lrec = self.stats.records[leader]
+            if lrec.status not in ("queued", "staged"):
+                continue
+            for e in list(fl):
+                if (self.stats.rounds - e.record.arrival_round
+                        >= self._deadline):
+                    self._shed(e.record, "shed_deadline")
+        self._drain_promotions()
 
-    def _take_chunk(self):
-        """Up to ``test_batch_size`` same-bucket requests, head-of-queue's
-        bucket, arrival order preserved for taken AND left-behind."""
+    def _take_chunk(self, eng: SlotEngine):
+        """Same-bucket requests off the queue head, arrival order
+        preserved for taken AND left-behind; returns (bucket, groups).
+        Cache off: one group of up to ``test_batch_size`` requests — the
+        historical take. Cache on: the walk PARTITIONS into a hit group
+        (artifacts in ``eng``'s prefix cache — admitted from cache, no
+        prefill dispatch) and a miss group, each packing up to a full
+        batch: hits don't consume miss-batch rows, so repeated traffic
+        cannot fragment the misses' prefill batches (which is where the
+        dispatch saving lives). Order within each group stays arrival
+        order, and output is position-keyed, so bytes are unchanged."""
         bucket = self._queue[0].bucket
-        take: List[_Queued] = []
+        hits: List[_Queued] = []
+        misses: List[_Queued] = []
         rest: "collections.deque[_Queued]" = collections.deque()
-        while self._queue and len(take) < self._bs:
+        probe = self._dedup_on
+        while self._queue and len(hits) < self._bs \
+                and len(misses) < self._bs:
             e = self._queue.popleft()
-            (take if e.bucket == bucket else rest).append(e)
+            if e.bucket != bucket:
+                rest.append(e)
+                continue
+            if probe and eng.cache_contains(e.digest):
+                hits.append(e)
+            else:
+                misses.append(e)
+        held: List[_Queued] = []
+        if probe and 0 < len(misses) < self._bs:
+            # fragmentary miss group: hold it (back to the true queue
+            # head, ahead of everything the walk skipped) so it packs
+            # with later misses instead of wasting a prefill dispatch —
+            # bounded by MISS_HOLD_ROUNDS on the group head's wait and
+            # by replica idleness (a group never waits while the
+            # claiming replica has nothing else to do, and rounds only
+            # advance while work is in flight, so the hold can never
+            # deadlock)
+            busy = eng.in_flight() > 0 or eng.staged_rows > 0
+            warm = bool(hits) or eng.stats.cache_hits > 0
+            head_wait = self.stats.rounds - min(
+                e.record.arrival_round for e in misses)
+            if busy and warm and head_wait < MISS_HOLD_ROUNDS:
+                held, misses = misses, []
         rest.extend(self._queue)
         self._queue = rest
-        for e in take:
+        for e in reversed(held):
+            self._queue.appendleft(e)
+        for e in hits + misses:
             # keep the single-row payload until the request finishes: the
             # requeue source if the replica serving it retires mid-flight
             self._payloads[e.record.position] = e
-        return bucket, take
+        return bucket, [g for g in (hits, misses) if g]
 
     def _form_batch(self, bucket: int, take: List[_Queued]) -> Dict:
         """Pack the taken requests' pre-assembled rows into one batch at
@@ -443,6 +624,11 @@ class ServeLoop:
         batch["_positions"] = positions
         if self._table is not None:
             batch["_tag"] = buckets_lib.geom_tag(self._table[bucket])
+        if self._dedup_on:
+            # forward the worker-stamped content digests so the engine's
+            # cache lookup never re-hashes (host-only field, wire-stripped)
+            batch["_digests"] = ([e.digest for e in take]
+                                 + [None] * (self._bs - len(take)))
         return batch
 
     def _prefill_quarantined(self, eng: SlotEngine, batch: Dict,
@@ -512,6 +698,13 @@ class ServeLoop:
             rec.requeues += 1
             rec.status = "queued"
             rec.admit_t = rec.seat_t = rec.first_step_t = math.nan
+            # a requeued leader drags its coalesced followers back to the
+            # queued milestone with it (they stay attached — re-admission
+            # payloads survive dedup; the deadline clocks do not reset)
+            for f in self._followers.get(rec.position, []):
+                f.record.status = "queued"
+                f.record.admit_t = f.record.seat_t = math.nan
+                f.record.first_step_t = math.nan
         self.stats.requeues += len(entries)
         for e in reversed(entries):
             self._queue.appendleft(e)
@@ -523,10 +716,20 @@ class ServeLoop:
         """No live replicas: every request not yet final is shed with the
         reason recorded — the run terminates with a position-complete
         output file and an honest metrics artifact, never a hang."""
-        while self._queue:
-            e = self._queue.popleft()
+        while self._queue or self._promoted:
+            e = (self._promoted.pop(0) if self._promoted
+                 else self._queue.popleft())
             e.record.error = e.record.error or reason
             self._shed(e.record, "shed_error")
+        # safety net: followers whose leader is neither queued nor
+        # promoted (the shed->promote chain above normally drains them)
+        for _leader, fl in list(self._followers.items()):
+            for e in list(fl):
+                if e.record.status not in ("done", "shed_queue_full",
+                                           "shed_deadline", "shed_error"):
+                    e.record.error = e.record.error or reason
+                    self._shed(e.record, "shed_error")
+        self._followers.clear()
         while self._arr_idx < len(self._times):
             item = next(self._feed_iter)
             rec = self.stats.records[self._arr_idx]
@@ -552,20 +755,52 @@ class ServeLoop:
             if eng not in self.engines:
                 continue  # retired earlier in this very round
             n = 0
+            retired = False
             while n < self._budget and self._queue and eng.wants_input():
-                bucket, take = self._take_chunk()
-                staged = self._prefill_quarantined(
-                    eng, self._form_batch(bucket, take), take)
-                if staged is None:
-                    break  # replica retired; its chunk is requeued
-                if not staged:
-                    continue  # chunk shed; the queue head moved on
-                self.clock.on_prefill()
-                t = self.clock.now()
-                for e in take:
-                    e.record.admit_t = t
-                    e.record.status = "staged"
-                n += 1
+                # hit/miss partition (cfg.prefix_cache): requests whose
+                # prefill artifacts sit in THIS replica's cache form
+                # their own chunk, admitted from cache with no prefill
+                # dispatch and no budget charge (that is the latency win
+                # — a cached admission never stalls the seated slots'
+                # next step); misses pack a normal prefilled chunk.
+                bucket, groups = self._take_chunk(eng)
+                if not groups:
+                    break  # a held miss group: it dispatches within
+                    #        MISS_HOLD_ROUNDS once rounds advance
+                for gi, group in enumerate(groups):
+                    before = eng.stats.prefills
+                    staged = self._prefill_quarantined(
+                        eng, self._form_batch(bucket, group), group)
+                    if staged is None:
+                        retired = True
+                        # the replica died dispatching THIS group (it was
+                        # requeued by _retire_replica); any group taken
+                        # off the queue but not yet dispatched must go
+                        # back too, or its requests are stranded in
+                        # 'queued' forever and the loop stalls
+                        for g in reversed(groups[gi + 1:]):
+                            for e in reversed(g):
+                                self._queue.appendleft(e)
+                        break
+                    if not staged:
+                        # chunk shed; promotions from shed leaders re-enter
+                        self._drain_promotions()
+                        continue
+                    # the virtual clock and the latency budget charge per
+                    # PREFILL DISPATCH: a cache-served or fully-coalesced
+                    # admission dispatched nothing and costs neither
+                    if eng.stats.prefills > before:
+                        self.clock.on_prefill()
+                        n += 1
+                    t = self.clock.now()
+                    for e in group:
+                        e.record.admit_t = t
+                        e.record.status = "staged"
+                        for f in self._followers.get(e.record.position, []):
+                            f.record.admit_t = t
+                            f.record.status = "staged"
+                if retired:
+                    break
             admitted += n
             if eng not in self.engines:
                 continue
@@ -586,6 +821,14 @@ class ServeLoop:
                     rec.seat_t = t
                     rec.status = "seated"
                     self._awaiting_first_step.append(rec)
+                    # a seated leader seats its whole fan-out group: each
+                    # follower keeps its own stamps but reaches the seat
+                    # milestone at the same dispatch boundary
+                    for f in self._followers.get(pid, []):
+                        if math.isnan(f.record.seat_t):
+                            f.record.seat_t = t
+                            f.record.status = "seated"
+                            self._awaiting_first_step.append(f.record)
 
     # --- the loop -------------------------------------------------------
 
@@ -613,7 +856,8 @@ class ServeLoop:
             self._admit()
             live = [e for e in self.engines if e.in_flight()]
             if not live:
-                if self._queue or any(e.staged_rows for e in self.engines):
+                if self._queue or self._promoted \
+                        or any(e.staged_rows for e in self.engines):
                     continue    # seats free up / budget admits next round
                 if self._arr_idx < n:
                     # idle: jump (virtual) / sleep (wall) to the next
@@ -627,6 +871,13 @@ class ServeLoop:
                     raise RuntimeError(
                         "serve loop stalled with requests unaccounted for")
                 break
+            if self._dedup_on:
+                # tell each replica which of its seats serve a fan-out
+                # group (loop-level dedup keeps the followers up here) so
+                # the engine's shared-block high-water meter covers them
+                leaders = {p for p, fl in self._followers.items() if fl}
+                for eng in live:
+                    eng.shared_positions = leaders
             for eng in live:
                 try:
                     if self._faults is not None:
@@ -664,6 +915,34 @@ class ServeLoop:
                 self._payloads.pop(it.position, None)
                 self.stats.completions.append(it.position)
                 self.emit(it.position, it.host, it.row, it.tokens, it.probs)
+                # dedup fan-out delivery: the leader's settled beams are
+                # byte-identical to what every coalesced follower's own
+                # decode would have produced (same digest => same packed
+                # payload), so each follower emits them at its OWN output
+                # position with its OWN lifecycle stamps
+                d = self._leader_digest.pop(it.position, None)
+                if d is not None:
+                    self._leaders.pop(d, None)
+                group = self._followers.pop(it.position, [])
+                if group:
+                    self.stats.dedup_groups += 1
+                    self.stats.dedup_fanout_max = max(
+                        self.stats.dedup_fanout_max, 1 + len(group))
+                for f in group:
+                    fr = f.record
+                    if math.isnan(fr.first_step_t):
+                        # coalesced after the leader's first step: its
+                        # first observable progress IS this harvest
+                        fr.first_step_t = t
+                    fr.done_t = t
+                    fr.done_round = self.stats.rounds
+                    fr.status = "done"
+                    if self._deadline and (fr.done_round - fr.arrival_round
+                                           > self._deadline):
+                        fr.deadline_missed = True
+                    self._final += 1
+                    self.stats.completions.append(fr.position)
+                    self.emit(fr.position, f.host, 0, it.tokens, it.probs)
             if (self._snapshot is not None
                     and self.stats.rounds % SNAPSHOT_EVERY_ROUNDS == 0):
                 self._snapshot(self)
@@ -674,22 +953,36 @@ class ServeLoop:
 # driver (the serving twin of decode.runner.run_test)
 # --------------------------------------------------------------------------
 
-def _request_tasks(data, cfg: FiraConfig, n: int, table, assignment):
-    """One single-row ``make_batch`` task per request, split order — the
+def _request_tasks(data, cfg: FiraConfig, n: int, table, assignment,
+                   mix=None):
+    """One single-row ``make_batch`` task per request, request order — the
     async Feeder pre-assembles request payloads ahead of their arrival
     (an open-loop generator knows its requests up front; arrival TIME, not
     assembly, is what admission is gated on). Each task carries a ``note``
     (request position + bucket geometry) so a poisoned payload's recorded
-    error names its sample."""
+    error names its sample.
+
+    ``mix``: optional request->split-position map (request ``i`` serves
+    sample ``mix[i]``; identity when None) — the repeated-traffic door:
+    byte-identical requests at distinct output positions, which is what
+    the prefix cache and the in-flight dedup exist for. With
+    ``cfg.prefix_cache`` each task also stamps the payload's content
+    digest WORKER-side (prefix_cache.stamp_digests), so the scheduler
+    thread never pays the hashing."""
     from fira_tpu.data.batching import make_batch
     from fira_tpu.data.feeder import task_note
+    from fira_tpu.decode.prefix_cache import stamp_digests
 
+    stamp = cfg.prefix_cache
     for i in range(n):
+        j = int(mix[i]) if mix is not None else i  # firacheck: allow[HOST-SYNC] mix is a host request->sample index map; task generation is pure host-side planning
         geom = table[int(assignment[i])] if table is not None else None  # firacheck: allow[HOST-SYNC] host numpy bucket-assignment array — task generation is pure host-side planning
-        task = (lambda i=i, geom=geom: make_batch(
-            data, np.asarray([i]), cfg, batch_size=1, geom=geom))  # firacheck: allow[HOST-SYNC] np.asarray of a host int list builds the make_batch index chunk; no device value exists here
+        def task(j=j, geom=geom):
+            b = make_batch(data, np.asarray([j]), cfg, batch_size=1,  # firacheck: allow[HOST-SYNC] np.asarray of a host int list builds the make_batch index chunk; no device value exists here
+                           geom=geom)
+            return stamp_digests(b) if stamp else b
         task.note = task_note(
-            [i], geom_tag=buckets_lib.geom_tag(geom) if geom else None,
+            [j], geom_tag=buckets_lib.geom_tag(geom) if geom else None,
             site="serve request")
         yield task
 
@@ -735,7 +1028,8 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
                 prefill_cost_s: float = 1.0,
                 engine=None,
                 faults=None,
-                metrics_path: Optional[str] = None) -> Dict:
+                metrics_path: Optional[str] = None,
+                request_mix=None) -> Dict:
     """Serve the first ``len(arrival_times)`` samples of ``split`` as an
     open-loop request stream (request ``i`` = split position ``i``,
     arriving at ``arrival_times[i]``). Writes the same position-ordered
@@ -758,7 +1052,13 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
     THROUGH the run — a ``<path>.partial`` snapshot refreshes atomically
     every few scheduler rounds (and once on abort), and the final file
     is written atomically (tmp + rename) at completion, matching the
-    ordered writer's crash contract (docs/FAULTS.md)."""
+    ordered writer's crash contract (docs/FAULTS.md).
+    ``request_mix``: optional request->split-position map (request ``i``
+    serves sample ``request_mix[i]``; identity when None). Repeated
+    entries are byte-identical requests at distinct output positions —
+    the repeated-traffic regime the prefix cache / in-flight dedup
+    (cfg.prefix_cache) exist for; the bench and chaos repeat legs drive
+    exactly this."""
     cfg = cfg or dataset.cfg
     if faults is None:
         faults = faults_lib.injector_from(cfg)
@@ -767,7 +1067,19 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
     indices = dataset.split_indices[split]
     times = np.asarray(arrival_times, dtype=np.float64)
     n_req = len(times)
-    if n_req > len(data):
+    mix = None
+    if request_mix is not None:
+        mix = np.asarray(request_mix, dtype=np.int64)
+        if len(mix) != n_req:
+            raise ValueError(
+                f"request_mix has {len(mix)} entries for {n_req} arrivals")
+        if len(mix) and (mix.min() < 0 or mix.max() >= len(data)):
+            raise ValueError(
+                f"request_mix references split position "
+                f"{int(mix.min()) if mix.min() < 0 else int(mix.max())} "
+                f"outside split {split!r} (size {len(data)})")
+        indices = np.asarray(indices)[mix]
+    elif n_req > len(data):
         raise ValueError(
             f"arrival trace has {n_req} requests but split {split!r} holds "
             f"only {len(data)} samples")
@@ -787,6 +1099,9 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
         ext = buckets_lib.sample_extents(data, cfg)
         assignment = buckets_lib.assign_buckets(
             ext, table, use_msg=cfg.decode_tar_buckets)
+        if mix is not None:
+            # request-indexed view: request i's bucket is its SAMPLE's
+            assignment = np.asarray(assignment)[mix]
     else:
         table = assignment = None
 
@@ -845,7 +1160,7 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
             })
 
     with OrderedStreamWriter(out_path, expected=n_req) as writer, \
-            Feeder(_request_tasks(data, cfg, n_req, table, assignment),
+            Feeder(_request_tasks(data, cfg, n_req, table, assignment, mix),
                    num_workers=cfg.feeder_workers, depth=cfg.feeder_depth,
                    put=False,
                    # the per-task error channel: a poisoned payload is

@@ -185,6 +185,124 @@ def smoke() -> int:
             "compiles_after_warmup": extra_compiles,
             **({"byte_violations": bad[:3]} if bad else {}),
         })
+    # --- prefix-cache chaos legs (docs/DECODE_ENGINE.md "Prefix cache &
+    # dedup"): faults at the cache.lookup site must degrade to MISSES —
+    # never a wrong answer — and a replica retiring with shared (fan-out)
+    # blocks in flight must requeue its followers and leak zero blocks.
+    # Repeated traffic via a fixed request mix (request i serves sample
+    # mix[i]); the byte reference is the cache-ON no-fault run, itself
+    # checked byte-equal to cache-OFF.
+    from fira_tpu.robust import faults as faults_lib
+
+    mix = [i % 7 for i in range(48)]
+    cache_times = poisson_times(len(mix), rate=1.5, seed=3)
+    ccfg = cfg.replace(prefix_cache=True)
+    m_off = serve_split(model, params, dataset, cfg,
+                        arrival_times=cache_times,
+                        out_dir=os.path.join(work, "cache_off"),
+                        split="train", clock="virtual", request_mix=mix)
+    m_ref = serve_split(model, params, dataset, ccfg,
+                        arrival_times=cache_times,
+                        out_dir=os.path.join(work, "cache_ref"),
+                        split="train", clock="virtual", request_mix=mix)
+    ref_cache_bytes = open(m_ref["output_path"], "rb").read()
+    base_ok = (ref_cache_bytes == open(m_off["output_path"], "rb").read()
+               and m_ref["engine"]["cache_hits"] > 0)
+    ok = ok and base_ok
+    results.append({"leg": "cache:baseline", "ok": base_ok,
+                    "cache_hits": m_ref["engine"]["cache_hits"],
+                    "dedup_coalesced": m_ref["serve"]["dedup_coalesced"]})
+
+    for kind, seed in (("raise", 7), ("corrupt", 7)):
+        c = ccfg.replace(inject_faults=f"cache.lookup:{kind}:0.5:{seed}")
+        inj = faults_lib.injector_from(c)
+        with sanitizer.sanitize(nans=False, infs=False) as guard:
+            m = serve_split(model, params, dataset, c,
+                            arrival_times=cache_times,
+                            out_dir=os.path.join(work, f"cache_{kind}"),
+                            split="train", clock="virtual", guard=guard,
+                            faults=inj, request_mix=mix)
+            extra_compiles = guard.compiles_after_warmup()
+        fired = sum(m.get("faults", {}).values())
+        e = m["serve"]
+        # the whole contract in one line: a cache fault is a MISS —
+        # bytes stay EXACTLY the no-fault bytes, nothing is shed, and a
+        # corrupt read is caught by the checksum and the entry dropped
+        leg_ok = (fired > 0 and extra_compiles == 0
+                  and e["completed"] == len(mix)
+                  and open(m["output_path"], "rb").read() == ref_cache_bytes
+                  and (kind != "corrupt"
+                       or m["engine"]["cache_integrity_drops"] > 0))
+        ok = ok and leg_ok
+        results.append({
+            "leg": f"cache.lookup:{kind}", "ok": leg_ok, "fired": fired,
+            "completed": e["completed"],
+            "cache_hits": m["engine"]["cache_hits"],
+            "integrity_drops": m["engine"]["cache_integrity_drops"],
+            "compiles_after_warmup": extra_compiles,
+        })
+
+    # retirement with shared blocks in flight: a replica dies mid-decode
+    # while seats serve coalesced fan-out groups; followers requeue with
+    # their leaders onto the survivor, every request completes with the
+    # no-fault bytes, and the pool accounting of EVERY replica (retired
+    # included) returns to baseline — zero leaked blocks.
+    from fira_tpu.data import buckets as buckets_lib
+    from fira_tpu.parallel import fleet as fleet_lib
+
+    burst_mix = [i % 13 for i in range(40)]
+    burst_times = [0.0] * len(burst_mix)   # all in flight together
+    m_ref2 = serve_split(model, params, dataset, ccfg,
+                         arrival_times=burst_times,
+                         out_dir=os.path.join(work, "retire_ref"),
+                         split="train", clock="virtual",
+                         request_mix=burst_mix)
+    # rate/seed picked so ONE replica retires mid-burst on this fixed
+    # schedule (survivor absorbs the requeue; the all-replicas-lost path
+    # has its own legacy leg above)
+    rcfg = ccfg.replace(inject_faults="engine.step:raise:0.06:11")
+    inj = faults_lib.injector_from(rcfg)
+    fleet = fleet_lib.EngineFleet(model, params, rcfg, replicas=2,
+                                  faults=inj)
+    data = dataset.splits["train"]
+    table = buckets_lib.decode_table(rcfg)
+    fleet.prewarm(
+        (buckets_lib.warmup_batch(data, rcfg, g, rcfg.test_batch_size),
+         buckets_lib.geom_tag(g)) for g in table)
+    m = serve_split(model, params, dataset, rcfg,
+                    arrival_times=burst_times,
+                    out_dir=os.path.join(work, "retire"), split="train",
+                    clock="virtual", engine=fleet, faults=inj,
+                    request_mix=burst_mix)
+    sv = m["serve"]
+    leaks = []
+    for eng in fleet.engines:
+        leaks += eng.allocator_invariants()
+        if len(eng._free_blocks) != eng._pool_blocks or eng._block_refs:
+            leaks.append(f"replica {eng.tag}: "
+                         f"{eng._pool_blocks - len(eng._free_blocks)} "
+                         f"block(s) never returned")
+    followers_done = sum(1 for r in m["request_records"]
+                         if r["coalesced_into"] is not None
+                         and r["status"] == "done")
+    leg_ok = (sv["replica_retirements"] >= 1
+              and sv["requeued_requests"] > 0
+              and sv["completed"] == len(burst_mix)
+              and sv["dedup_coalesced"] > 0 and followers_done > 0
+              and not leaks
+              and (open(m["output_path"], "rb").read()
+                   == open(m_ref2["output_path"], "rb").read()))
+    ok = ok and leg_ok
+    results.append({
+        "leg": "cache:retire_shared_blocks", "ok": leg_ok,
+        "retirements": sv["replica_retirements"],
+        "requeued": sv["requeued_requests"],
+        "dedup_coalesced": sv["dedup_coalesced"],
+        "followers_completed": followers_done,
+        "shared_block_peak": m["engine"]["shared_block_peak"],
+        **({"block_leaks": leaks[:3]} if leaks else {}),
+    })
+
     print(json.dumps({"smoke": "ok" if ok else "FAIL", "n_requests": n,
                       "legs": results}), flush=True)
     return 0 if ok else 1

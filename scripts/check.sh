@@ -10,21 +10,23 @@ cd "$(dirname "$0")/.."
 echo "== firacheck: static JAX-hazard scan =="
 # fira_tpu/data/feeder.py, fira_tpu/data/buckets.py,
 # fira_tpu/data/grouping.py, fira_tpu/decode/engine.py,
-# fira_tpu/decode/paging.py, fira_tpu/parallel/fleet.py,
+# fira_tpu/decode/paging.py, fira_tpu/decode/prefix_cache.py,
+# fira_tpu/parallel/fleet.py,
 # fira_tpu/serve/server.py, fira_tpu/robust/faults.py and
 # fira_tpu/robust/watchdog.py are named explicitly (as well as being
 # inside the fira_tpu tree, which the CLI dedupes): the async input
 # pipeline, the bucket packer, the grouped dispatch scheduler, the
 # slot-refill decode engine, the paged-KV arena geometry/validation, the
-# replicated decode fleet, the arrival-timed serving loop and the
-# fault-injection/watchdog machinery are designated driver modules
-# (astutil._DRIVER_FILES) whose threaded/packing/refill/admission loops
-# MUST stay in the self-scan even if the directory arguments ever
-# change.
+# cross-request prefix cache, the replicated decode fleet, the
+# arrival-timed serving loop and the fault-injection/watchdog machinery
+# are designated driver modules (astutil._DRIVER_FILES) whose
+# threaded/packing/refill/admission loops MUST stay in the self-scan
+# even if the directory arguments ever change.
 JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu fira_tpu/data/feeder.py fira_tpu/data/buckets.py \
     fira_tpu/data/grouping.py fira_tpu/decode/engine.py \
-    fira_tpu/decode/paging.py fira_tpu/parallel/fleet.py \
+    fira_tpu/decode/paging.py fira_tpu/decode/prefix_cache.py \
+    fira_tpu/parallel/fleet.py \
     fira_tpu/serve/server.py fira_tpu/robust/faults.py \
     fira_tpu/robust/watchdog.py tests scripts \
     || exit $?
@@ -40,6 +42,14 @@ echo "== serve smoke: fixed-trace replay under the compile guard (docs/SERVING.m
 # replay through the slot engine under the armed compile guard — output
 # bytes must equal drain mode and zero post-warmup compiles must hold.
 JAX_PLATFORMS=cpu python scripts/serve_bench.py --smoke || exit $?
+
+echo "== prefix-cache smoke: duplicate-trace replay, cache on == cache off (docs/DECODE_ENGINE.md) =="
+# The cross-request prefix cache + in-flight dedup stay bit-exact in
+# tier-1: a fixed duplicate-heavy trace replayed under the armed compile
+# guard — cache-on output bytes must equal cache-off bytes with real
+# hits AND coalescing happening, and zero post-warmup compiles must hold
+# (cache lookups are host-side; no new program geometry exists).
+JAX_PLATFORMS=cpu python scripts/serve_bench.py --cache-smoke || exit $?
 
 echo "== chaos smoke: seeded fault at each site (docs/FAULTS.md) =="
 # The graceful-degradation contracts stay machine-enforced in tier-1:
