@@ -151,6 +151,15 @@ bytes asserted identical (decode/prefix_cache.py, docs/DECODE_ENGINE.md
 "Prefix cache & dedup") — and folds its rows into this record; the full
 artifact lands in docs/CACHE_BENCH_r01.jsonl. FIRA_BENCH_CACHE_TIMEOUT
 caps the sweep, default 900 s),
+FIRA_BENCH_INGEST=1 (opt-in raw-diff ingest leg: runs
+scripts/serve_bench.py --ingest — reconstructed unified-diff traces
+served end to end through the online ingest pipeline (fira_tpu/ingest,
+docs/INGEST.md) next to the corpus-graph path at the same offered
+rates, with per-stage ingest latency, the ingest-stall fraction, and
+the single-worker ingest rate vs the 1,815 commits/sec/core offline
+preprocessing baseline — and folds its rows into this record; the full
+artifact lands in docs/INGEST_BENCH_r01.jsonl.
+FIRA_BENCH_INGEST_TIMEOUT caps the sweep, default 900 s),
 
 Composed leg — the production path going forward (ISSUE 4): the stacked
 knobs AND the auto bucket table together. One shuffled epoch plan of
@@ -853,6 +862,19 @@ def worker() -> None:
                                  "FIRA_BENCH_CACHE_TIMEOUT",
                                  args=("--cache",))
 
+    # (j) INGEST leg (opt-in: FIRA_BENCH_INGEST=1): raw-diff serving —
+    # scripts/serve_bench.py --ingest serves reconstructed unified-diff
+    # traces through the online ingest pipeline (fira_tpu/ingest) next
+    # to the corpus-graph path at the same offered rates and records
+    # per-stage ingest latency, the ingest-stall fraction, and the
+    # single-worker ingest rate vs the offline preprocessing baseline
+    # (docs/INGEST.md).
+    ingest = None
+    if os.environ.get("FIRA_BENCH_INGEST", "0") == "1":
+        ingest = _script_rows_leg("ingest", "serve_bench.py",
+                                  "FIRA_BENCH_INGEST_TIMEOUT",
+                                  args=("--ingest",))
+
     step_time = dt_e2e / steps_per_window
     compute_step_time = dt_compute / steps_per_window
     # metric of record: chip-side throughput (see module docstring "History
@@ -914,6 +936,10 @@ def worker() -> None:
         # full artifact is docs/CACHE_BENCH_r01.jsonl —
         # scripts/serve_bench.py --cache)
         **({"prefix_cache": cache} if cache else {}),
+        # raw-diff ingest serving rows (FIRA_BENCH_INGEST=1; the full
+        # artifact is docs/INGEST_BENCH_r01.jsonl —
+        # scripts/serve_bench.py --ingest)
+        **({"ingest": ingest} if ingest else {}),
         "feed_stall_frac_sync_assembly": sync_info["feed_stall_frac"],
         "value_e2e_sync_assembly": round(
             batch_size / (dt_sync / steps_per_window) / n_chips, 2),

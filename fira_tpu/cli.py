@@ -26,12 +26,17 @@ from typing import List, Optional
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="fira_tpu", description=__doc__)
     p.add_argument("command", choices=["train", "test", "serve",
-                                       "preprocess"],
+                                       "message", "preprocess"],
                    help="train: fit + dev-gate; test: beam-decode the test "
-                        "split; serve: decode the test split as a "
-                        "long-lived server under open-loop arrival-timed "
-                        "load (docs/SERVING.md); preprocess: raw diffs -> "
-                        "DataSet/ corpus")
+                        "split; serve: a long-lived server under open-loop "
+                        "arrival-timed load — corpus test split or, with "
+                        "--input diffs, raw unified-diff requests "
+                        "(docs/SERVING.md, docs/INGEST.md); message: "
+                        "one-shot diff-in/message-out on a single diff "
+                        "file; preprocess: raw diffs -> DataSet/ corpus")
+    p.add_argument("target", nargs="?", default=None,
+                   help="message: the unified-diff file to generate a "
+                        "commit message for (unused by other commands)")
     p.add_argument("--backend", default="jax", choices=["jax"],
                    help="compute backend (this framework is TPU/JAX-native)")
     p.add_argument("--config", default="fira-full",
@@ -176,6 +181,40 @@ def build_parser() -> argparse.ArgumentParser:
                         "per entry at production geometry). 0/unset = "
                         "unbounded (the entry cap is the only bound); "
                         "must be >= 0 — validated at parse time, exit 2")
+    p.add_argument("--input", default="graphs", choices=["graphs", "diffs"],
+                   help="serve: request source (docs/INGEST.md): 'graphs' "
+                        "(default) serves the corpus test split's "
+                        "pre-assembled graph requests; 'diffs' serves RAW "
+                        "unified git diffs from --diff-trace end to end — "
+                        "per-request diff parse + Java lexing + hunk FSM + "
+                        "AST extraction + frozen-vocab encoding run inside "
+                        "the feeder worker pool, malformed diffs are "
+                        "recorded-shed (never a crash), and a "
+                        "reconstructed corpus diff serves byte-identical "
+                        "output to the graphs path (the round-trip "
+                        "contract, machine-checked in check.sh)")
+    p.add_argument("--diff-trace", default=None, metavar="PATH",
+                   help="serve --input diffs: the request source — a file "
+                        "of '#! request'-separated unified diffs, or a "
+                        "directory of .diff files served in sorted name "
+                        "order (validated at parse time, exit 2). "
+                        "Arrival TIMES still come from --serve-rate / "
+                        "--serve-trace")
+    p.add_argument("--ingest-workers", type=int, default=None, metavar="N",
+                   help="serve --input diffs: feeder workers for the "
+                        "per-request ingest tasks (parse + AST extraction "
+                        "+ encode, worker-side). 0/unset = reuse "
+                        "--feeder-workers' config default; must be >= 0 "
+                        "(validated at parse time, exit 2)")
+    p.add_argument("--ingest-truncate", default=None,
+                   choices=["clip", "shed"],
+                   help="serve --input diffs: over-budget diff policy "
+                        "(docs/INGEST.md): 'clip' (default) "
+                        "deterministically truncates to the config "
+                        "geometry and records what was dropped in the "
+                        "request's ingest stamps; 'shed' rejects the "
+                        "request with a recorded error and an empty "
+                        "output line")
     p.add_argument("--serve-rate", type=float, default=None, metavar="RPS",
                    help="serve: offered load in requests/second for the "
                         "open-loop Poisson arrival generator; required "
@@ -217,7 +256,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seeded fault injection (docs/FAULTS.md): "
                         "'site:kind:rate:seed[,...]' arming named "
                         "injection points (sites: feeder.assemble, "
-                        "feeder.device_put, engine.prefill, engine.step, "
+                        "feeder.device_put, ingest.parse, engine.prefill, "
+                        "engine.step, "
                         "engine.harvest, fleet.replica, serve.admit, "
                         "cache.lookup; "
                         "kinds: raise | hang | corrupt). Deterministic "
@@ -377,6 +417,10 @@ def _resolve_cfg(args):
         overrides["decode_engine"] = True
         if args.prefix_cache is None:
             overrides["prefix_cache"] = True
+    if args.ingest_workers is not None:
+        overrides["ingest_workers"] = args.ingest_workers
+    if args.ingest_truncate is not None:
+        overrides["ingest_truncate"] = args.ingest_truncate
     if args.prefix_cache is not None:
         overrides["prefix_cache"] = args.prefix_cache == "on"
     if args.prefix_cache_entries is not None:
@@ -466,6 +510,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         return preprocess_main(args)
 
     cfg = _resolve_cfg(args)
+
+    # Raw-diff ingest admission (docs/INGEST.md) validates BEFORE the
+    # dataset loads — a missing --diff-trace or a bad knob must exit 2
+    # immediately, same named-knob contract as the blocks below.
+    if args.command in ("serve", "message"):
+        from fira_tpu.ingest.service import ingest_errors
+
+        ingest_errs = ingest_errors(cfg, input_mode=args.input,
+                                    diff_trace=args.diff_trace,
+                                    command=args.command)
+        if args.command == "message":
+            if not args.target:
+                ingest_errs.append(
+                    "message needs a diff file: cli message <diff-file>")
+            elif not os.path.isfile(args.target):
+                ingest_errs.append(
+                    f"message target {args.target}: not a readable file")
+        if ingest_errs:
+            for e in ingest_errs:
+                print(f"parse-time validation: {e}", file=sys.stderr)
+            return 2
+
     from fira_tpu.data.dataset import FiraDataset
 
     dataset = FiraDataset(args.data_dir, cfg)
@@ -606,16 +672,44 @@ def main(argv: Optional[List[str]] = None) -> int:
               "decoding the LATEST training state", file=sys.stderr)
         params = ckpt.restore_latest(template)[0].params
 
+    if args.command == "message":
+        # one-shot diff-in / message-out (docs/INGEST.md): ingest the
+        # target diff, run the batched beam on its single-row payload,
+        # print the cooked message — the smallest raw-diff path
+        from fira_tpu.ingest.difftext import DiffParseError
+        from fira_tpu.ingest.service import IngestError, one_shot_message
+
+        try:
+            with open(args.target) as f:
+                text = f.read()
+            print(one_shot_message(model, params, dataset.word_vocab,
+                                   dataset.ast_change_vocab, cfg, text))
+        except (DiffParseError, IngestError, UnicodeDecodeError,
+                OSError) as e:
+            # a request-content failure, named like every other rejected
+            # input (the serve path records-and-sheds the same errors)
+            print(f"message: {args.target} rejected: {e}", file=sys.stderr)
+            return 1
+        return 0
+
     if args.command == "serve":
         from fira_tpu.serve import poisson_times, read_trace, serve_split
 
-        n_req = len(split)
+        if args.input == "diffs":
+            from fira_tpu.ingest.difftext import read_diff_trace
+
+            requests = read_diff_trace(args.diff_trace)
+            n_req = len(requests)
+        else:
+            n_req = len(split)
         if args.serve_trace:
             times = read_trace(args.serve_trace)
             if len(times) > n_req:
                 print(f"parse-time validation: --serve-trace has "
-                      f"{len(times)} arrivals but the test split holds "
-                      f"only {n_req} samples", file=sys.stderr)
+                      f"{len(times)} arrivals but the request source "
+                      f"holds only {n_req} "
+                      f"{'diffs' if args.input == 'diffs' else 'samples'}",
+                      file=sys.stderr)
                 return 2
         else:
             times = poisson_times(n_req, cfg.serve_rate, seed=cfg.seed)
@@ -625,11 +719,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         # atomically at completion — the ordered writer's crash contract
         # applied to metrics (docs/FAULTS.md)
         metrics_path = os.path.join(args.out_dir, "serve_metrics.json")
-        metrics = serve_split(model, params, dataset, cfg,
-                              arrival_times=times, out_dir=args.out_dir,
-                              ablation=args.ablation, var_maps=var_maps,
-                              guard=guard, clock=args.serve_clock,
-                              metrics_path=metrics_path)
+        if args.input == "diffs":
+            from fira_tpu.ingest.service import serve_diffs
+
+            metrics = serve_diffs(model, params, dataset.word_vocab,
+                                  dataset.ast_change_vocab, cfg,
+                                  requests=requests[: len(times)],
+                                  arrival_times=times,
+                                  out_dir=args.out_dir,
+                                  ablation=args.ablation, guard=guard,
+                                  clock=args.serve_clock,
+                                  metrics_path=metrics_path)
+        else:
+            metrics = serve_split(model, params, dataset, cfg,
+                                  arrival_times=times, out_dir=args.out_dir,
+                                  ablation=args.ablation, var_maps=var_maps,
+                                  guard=guard, clock=args.serve_clock,
+                                  metrics_path=metrics_path)
         sv = metrics["serve"]
         print(f"serve: {sv['completed']}/{sv['offered']} completed "
               f"(shed {sv['shed_queue_full']} queue-full, "
@@ -639,6 +745,12 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"p50/p99 ttft {sv['p50_ttft_s']}/{sv['p99_ttft_s']} s  "
               f"p50/p99 e2e {sv['p50_e2e_s']}/{sv['p99_e2e_s']} s  "
               f"-> {metrics_path}")
+        if "ingest" in sv:
+            ing = sv["ingest"]
+            print(f"ingest: {ing['requests_ingested']} requests "
+                  f"({ing['truncated']} truncated, {ing['degraded']} "
+                  f"degraded)  p50 ingest {ing['p50_total_s']} s  "
+                  f"ingest_stall_frac {ing['stall_frac']}")
         return 0
 
     metrics = run_test(model, params, dataset, cfg, out_dir=args.out_dir,

@@ -281,12 +281,33 @@ class FiraConfig:
     # output file keeps the position with an empty line). 0 = unbounded.
     serve_queue_cap: int = 0
 
+    # --- online raw-diff ingest (ingest/; docs/INGEST.md) ---
+    # Feeder workers dedicated to per-request diff ingest tasks (parse +
+    # AST extraction + encode + single-row assembly, run worker-side so
+    # the scheduler thread never pays them). 0 = reuse feeder_workers —
+    # the default; ingest is the same bounded worker pool as corpus
+    # assembly, just heavier per task. Must be >= 0 (validated at parse
+    # time, CLI exit 2 — ingest.service.ingest_errors).
+    ingest_workers: int = 0
+    # Over-budget policy for a diff whose measured extents exceed the
+    # config geometry (sou/sub/ast-change/max_edges budgets):
+    # "clip" (default) deterministically truncates — trailing diff
+    # tokens at a chunk-safe boundary, whole tokens' sub-token lists,
+    # trailing AST/change nodes with their edges, trailing family edges
+    # — and records exactly what was dropped in the request's ingest
+    # stamps; "shed" rejects the request with a recorded error (empty
+    # output line, the quarantine contract). Either way the assembled
+    # payload ALWAYS fits its bucket: admissibility is decided here, at
+    # ingest, never by a mid-loop make_batch backstop. Must be
+    # clip|shed (validated at parse time, exit 2).
+    ingest_truncate: str = "clip"
+
     # --- robustness / fault injection (robust/; docs/FAULTS.md) ---
     # Seeded fault-injection spec "site:kind:rate:seed[,...]" arming named
     # injection points along the request path (sites: feeder.assemble,
-    # feeder.device_put, engine.prefill, engine.step, engine.harvest,
-    # fleet.replica, serve.admit, cache.lookup; kinds: raise | hang |
-    # corrupt).
+    # feeder.device_put, ingest.parse, engine.prefill, engine.step,
+    # engine.harvest, fleet.replica, serve.admit, cache.lookup; kinds:
+    # raise | hang | corrupt).
     # Deterministic given the seed — every chaos run replays exactly —
     # and validated at parse time (robust.faults.robust_errors, CLI
     # exit 2). "" = off: the injector is None and every site check is one

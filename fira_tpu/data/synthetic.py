@@ -235,6 +235,43 @@ def write_corpus_dir(data_dir: str, n_commits: int, seed: int = 0,
     return corpus
 
 
+def write_extracted_corpus_dir(data_dir: str, n_commits: int, seed: int = 0,
+                               min_freq: int = 1) -> Corpus:
+    """A corpus whose graph streams come from the REAL extraction
+    pipeline instead of the random synthetic ones: the synthetic
+    difftoken/diffmark/msg/variable streams are kept, ``diffatt`` is
+    re-derived (pipeline.derive_diffatt — the reference convention), and
+    ast/change/edge_* are produced by ``pipeline.process_commits`` (FSM +
+    native astdiff extraction, per-commit degradation included).
+
+    This is the ROUND-TRIP corpus of the ingest equivalence contract
+    (docs/INGEST.md): a commit's reconstructed unified diff pushed
+    through ``fira_tpu/ingest`` re-runs the same FSM/extraction and must
+    reproduce these exact streams — hence byte-identical wire payloads
+    and served output (tests/test_ingest.py, check.sh ingest smoke)."""
+    import os
+
+    from fira_tpu.preprocess.pipeline import derive_diffatt, process_commits
+
+    corpus = generate_corpus(n_commits, seed=seed)
+    corpus.streams["diffatt"] = derive_diffatt(corpus.streams["difftoken"])
+    # index_offset clears the reference's per-corpus commit-70 hack
+    # (extract.ast_code_edges commit_index==70 'nextParent' special
+    # case): ingest extracts requests index-FREE, so the round-trip
+    # corpus must be extracted index-independently too or the byte
+    # contract would silently depend on a corpus index
+    streams, _errors = process_commits(corpus.streams["difftoken"],
+                                       corpus.streams["diffmark"],
+                                       0, n_commits,
+                                       index_offset=1_000_000)
+    corpus.streams.update(streams)
+    corpus.save(data_dir)
+    word_vocab, ast_vocab = build_vocabs(corpus, min_freq=min_freq)
+    word_vocab.to_json(os.path.join(data_dir, "word_vocab.json"))
+    ast_vocab.to_json(os.path.join(data_dir, "ast_change_vocab.json"))
+    return corpus
+
+
 def make_memory_split(cfg, n: int, seed: int = 0, pad_vocab_to: int = 0,
                       pad_ast_vocab_to: int = 0):
     """Generate a fully in-memory ProcessedSplit (no disk): returns

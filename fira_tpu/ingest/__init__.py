@@ -1,0 +1,25 @@
+"""Online raw-diff ingest: diff-in, message-out serving (docs/INGEST.md).
+
+``difftext`` is the text front end (unified-diff parse/reconstruct +
+Java lexing); ``service`` is the per-request pipeline (FSM -> AST
+extraction -> frozen-vocab encode -> single-row wire payload) and the
+``serve_diffs`` / ``one_shot_message`` drivers.
+"""
+
+from fira_tpu.ingest.difftext import (  # noqa: F401
+    DiffParseError,
+    DiffRequest,
+    parse_request,
+    read_diff_trace,
+    reconstruct_diff,
+    reconstruct_request,
+    write_diff_trace,
+)
+from fira_tpu.ingest.service import (  # noqa: F401
+    IngestError,
+    ingest_errors,
+    ingest_record,
+    ingest_request,
+    one_shot_message,
+    serve_diffs,
+)

@@ -42,6 +42,13 @@ from fira_tpu.config import FiraConfig
 SITES = (
     "feeder.assemble",    # host batch assembly on a feeder worker
     "feeder.device_put",  # the worker-side H2D transfer
+    "ingest.parse",       # raw-diff ingest on a feeder worker
+    #                       (ingest/service.py): raise/hang fire before
+    #                       the parse (the malformed-request class — the
+    #                       quarantine sheds with the reason recorded);
+    #                       corrupt scrambles the ASSEMBLED payload (a
+    #                       garbage request the downstream must serve or
+    #                       shed, never crash on)
     "engine.prefill",     # the engine's prefill dispatch (admit)
     "engine.step",        # the engine's step dispatch
     "engine.harvest",     # the done-mask readback + sliced row gather
@@ -56,9 +63,10 @@ SITES = (
 KINDS = ("raise", "hang", "corrupt")
 # corrupt scrambles a HOST payload in place; only the sites that own a
 # host payload qualify (every other site is a dispatch boundary with
-# nothing host-mutable): batch assembly, and the prefix-cache read path
-# (whose checksum must catch the scramble — docs/FAULTS.md)
-CORRUPT_SITES = ("feeder.assemble", "cache.lookup")
+# nothing host-mutable): batch assembly, raw-diff ingest assembly, and
+# the prefix-cache read path (whose checksum must catch the scramble —
+# docs/FAULTS.md)
+CORRUPT_SITES = ("feeder.assemble", "ingest.parse", "cache.lookup")
 
 
 class InjectedFault(RuntimeError):

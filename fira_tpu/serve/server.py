@@ -217,6 +217,13 @@ class RequestRecord:
     # it is delivered by fan-out at the leader's harvest, keeping its OWN
     # arrival/deadline/TTFT stamps (None for leaders and cache-off runs)
     coalesced_into: Optional[int] = None
+    # raw-diff ingest lifecycle stamps (docs/INGEST.md): per-stage
+    # worker-side seconds (lex_s/parse_s/assemble_s), token count, the
+    # deterministic-truncation record, the extraction-degradation reason,
+    # and OOV fallback counts — stamped by ingest.service on the payload
+    # (``_ingest``) and copied here at arrival. None on corpus-graph
+    # requests, which never ran ingest.
+    ingest: Optional[Dict] = None
 
     @property
     def queue_wait_s(self) -> float:
@@ -261,6 +268,15 @@ class ServeStats:
     dedup_coalesced: int = 0
     dedup_groups: int = 0
     dedup_fanout_max: int = 0
+    # the ingest twin of feed-stall (docs/INGEST.md): seconds the
+    # scheduler blocked waiting for a request's payload to come off the
+    # feeder workers at arrival time — for raw-diff serving this is
+    # exactly the ingest pipeline failing to stay ahead of arrivals
+    assembly_stall_s: float = 0.0
+    # REAL elapsed seconds of the whole loop run (perf_counter), the
+    # stall fraction's denominator — the scheduling clock may be
+    # virtual, but the stall is wall time, so the ratio must be too
+    wall_s: float = 0.0
 
     def summary(self) -> Dict:
         done = [r for r in self.records if r.status == "done"]
@@ -296,7 +312,43 @@ class ServeStats:
             "p50_e2e_s": _pct(e2e, 50), "p99_e2e_s": _pct(e2e, 99),
             "mean_e2e_s": round(float(np.mean(e2e)), 6) if e2e else None,
             "p50_queue_wait_s": _pct(qw, 50), "p99_queue_wait_s": _pct(qw, 99),
+            **self._ingest_summary(),
         }
+
+    def _ingest_summary(self) -> Dict:
+        """Aggregate raw-diff ingest stamps (docs/INGEST.md) — present
+        only when any request actually ran ingest, so corpus-graph serve
+        summaries stay byte-stable (the worker-count determinism
+        contract: ingest stage times and the assembly stall are real
+        wall seconds, honest but schedule-dependent)."""
+        ing = [r.ingest for r in self.records if r.ingest]
+        if not ing:
+            return {}
+        stage = {s: [i[s] for i in ing if s in i]
+                 for s in ("lex_s", "parse_s", "assemble_s")}
+        totals = [sum(i.get(s, 0.0) for s in
+                      ("lex_s", "parse_s", "assemble_s")) for i in ing]
+        out = {"requests_ingested": len(ing),
+               "truncated": sum(1 for i in ing if i.get("truncated")),
+               "degraded": sum(1 for i in ing if i.get("degraded")),
+               "oov_word_fallbacks": sum(int(i.get("oov_words", 0))
+                                         for i in ing),
+               "oov_ast_fallbacks": sum(int(i.get("oov_ast", 0))
+                                        for i in ing)}
+        for s, vals in stage.items():
+            out[f"mean_{s}"] = (round(float(np.mean(vals)), 9)
+                                if vals else None)
+        out["p50_total_s"] = _pct(totals, 50)
+        out["p99_total_s"] = _pct(totals, 99)
+        # the ingest twin of feed-stall: seconds the scheduler blocked at
+        # arrival waiting for a payload still on the ingest workers, and
+        # that stall as a fraction of the run's REAL wall time (both
+        # sides perf_counter seconds — a virtual-clock makespan would be
+        # a dimensionally meaningless denominator)
+        out["stall_s"] = round(self.assembly_stall_s, 6)
+        out["stall_frac"] = (round(self.assembly_stall_s / self.wall_s, 4)
+                             if self.wall_s else None)
+        return {"ingest": out}
 
 
 @dataclasses.dataclass
@@ -369,6 +421,17 @@ class ServeLoop:
 
     # --- pieces ---------------------------------------------------------
 
+    def _bucket_of(self, i: int, item) -> int:
+        """A request's decode bucket: the split-wide assignment array for
+        corpus-graph requests, the worker-stamped ``_bucket`` host field
+        for raw-diff ingest requests (assigned per request by measured
+        extents — ingest.service), 0 when unbucketed."""
+        if self._assignment is not None:
+            return int(self._assignment[i])
+        if item.host is not None and "_bucket" in item.host:
+            return int(item.host["_bucket"])
+        return 0
+
     def _poll_arrivals(self, now: float) -> None:
         """Move every due request into the admission queue. An arrival is
         shed on the spot when the bounded queue is full, when its payload
@@ -383,6 +446,9 @@ class ServeLoop:
             rec = self.stats.records[i]
             rec.arrival_round = self.stats.rounds
             rec.retries += int(item.retries)  # firacheck: allow[HOST-SYNC] FedBatch.retries is a host int counter stamped by the feeder worker; no device value exists here
+            if item.host is not None:
+                rec.ingest = item.host.get("_ingest")
+            self.stats.assembly_stall_s += float(item.stall_s)  # firacheck: allow[HOST-SYNC] FedBatch.stall_s is a host perf_counter float stamped by the feeder; no device value exists here
             digest = None
             if self._dedup_on and item.host is not None:
                 dl = item.host.get("_digests")
@@ -410,9 +476,8 @@ class ServeLoop:
                     self._shed(rec, "shed_queue_full")
                 else:
                     lrec = self.stats.records[leader]
-                    bucket = (int(self._assignment[i])  # firacheck: allow[HOST-SYNC] host numpy bucket-assignment array (data/buckets.assign_buckets) — admission runs on host index data only, never device values
-                              if self._assignment is not None else 0)
-                    e = _Queued(rec, item.host, bucket, digest=digest)
+                    e = _Queued(rec, item.host, self._bucket_of(i, item),
+                                digest=digest)
                     self._followers.setdefault(leader, []).append(e)
                     rec.coalesced_into = leader
                     rec.status = "queued"
@@ -433,12 +498,11 @@ class ServeLoop:
                 pass  # serve.admit fault past the retry budget: shed inside
             else:
                 rec.status = "queued"
-                bucket = (int(self._assignment[i])  # firacheck: allow[HOST-SYNC] host numpy bucket-assignment array (data/buckets.assign_buckets) — admission runs on host index data only, never device values
-                          if self._assignment is not None else 0)
                 if digest is not None:
                     self._leaders[digest] = rec.position
                     self._leader_digest[rec.position] = digest
-                self._queue.append(_Queued(rec, item.host, bucket,
+                self._queue.append(_Queued(rec, item.host,
+                                           self._bucket_of(i, item),
                                            digest=digest))
             self._arr_idx += 1
         self.stats.peak_queue_depth = max(self.stats.peak_queue_depth,
@@ -624,6 +688,13 @@ class ServeLoop:
         batch["_positions"] = positions
         if self._table is not None:
             batch["_tag"] = buckets_lib.geom_tag(self._table[bucket])
+        if any(e.host is not None and "_var" in e.host for e in take):
+            # per-request anonymization maps (raw-diff ingest requests,
+            # docs/INGEST.md): ride the packed batch as a host-only
+            # column so the emitter can de-anonymize each row's output
+            vm = [(e.host.get("_var") or [None])[0] if e.host else None
+                  for e in take]
+            batch["_var"] = vm + [None] * (self._bs - len(take))
         if self._dedup_on:
             # forward the worker-stamped content digests so the engine's
             # cache lookup never re-hashes (host-only field, wire-stripped)
@@ -734,6 +805,8 @@ class ServeLoop:
             item = next(self._feed_iter)
             rec = self.stats.records[self._arr_idx]
             rec.retries += int(item.retries)  # firacheck: allow[HOST-SYNC] FedBatch.retries is a host int counter stamped by the feeder worker; no device value exists here
+            if item.host is not None:
+                rec.ingest = item.host.get("_ingest")
             rec.error = rec.error or (str(item.error) if item.error
                                       else reason)
             self._shed(rec, "shed_error")
@@ -833,6 +906,7 @@ class ServeLoop:
     # --- the loop -------------------------------------------------------
 
     def run(self) -> ServeStats:
+        t0 = time.perf_counter()
         n = len(self._times)
         for eng in self.engines:
             # fresh host scheduling state per request stream (a no-op on
@@ -946,12 +1020,137 @@ class ServeLoop:
             if (self._snapshot is not None
                     and self.stats.rounds % SNAPSHOT_EVERY_ROUNDS == 0):
                 self._snapshot(self)
+        self.stats.wall_s = time.perf_counter() - t0
         return self.stats
 
 
 # --------------------------------------------------------------------------
 # driver (the serving twin of decode.runner.run_test)
 # --------------------------------------------------------------------------
+
+def make_clock(clock: str, *, step_cost_s: float = 1.0,
+               prefill_cost_s: float = 1.0):
+    """The serve drivers' clock selector (serve_split and
+    ingest.service.serve_diffs share it — one definition, no twin)."""
+    if clock == "wall":
+        return WallClock()
+    if clock == "virtual":
+        return VirtualClock(step_cost_s=step_cost_s,
+                            prefill_cost_s=prefill_cost_s)
+    raise ValueError(f"clock {clock!r} not in {{'wall', 'virtual'}}")
+
+
+def build_engines(model, params, cfg: FiraConfig, *, engine=None,
+                  engine_slots=None, guard=None, faults=None):
+    """Engine/fleet construction shared by the serve drivers: returns
+    (owner, engines, built) — ``built`` False when the caller passed a
+    (presumably warm) ``engine`` whose prewarm must not rerun."""
+    if engine is not None:
+        return engine, (getattr(engine, "engines", None) or [engine]), False
+    n_rep = max(1, int(cfg.engine_replicas))
+    if n_rep > 1:
+        from fira_tpu.parallel import fleet as fleet_lib
+
+        owner = fleet_lib.EngineFleet(model, params, cfg, replicas=n_rep,
+                                      slots=engine_slots, guard=guard,
+                                      faults=faults)
+        return owner, owner.engines, True
+    owner = SlotEngine(model, params, cfg, slots=engine_slots,
+                       guard=guard, faults=faults)
+    return owner, [owner], True
+
+
+def prepare_templates(owner, split, cfg: FiraConfig, table, *,
+                      guard=None, prewarm: bool = True) -> Dict[int, Dict]:
+    """Per-bucket all-pad templates (+ program-family prewarm when the
+    driver built the engine itself): the packed-batch scaffolding both
+    serve drivers share. ``split`` supplies shapes/dtypes only — the
+    corpus split for graph requests, a one-row template split for
+    raw-diff requests."""
+    from fira_tpu.data.batching import make_batch
+
+    bs = int(cfg.test_batch_size)
+    if table is not None:
+        if prewarm:
+            if guard is not None:
+                guard.declare(owner.labels(table))
+            owner.prewarm((buckets_lib.warmup_batch(split, cfg, g, bs),
+                           buckets_lib.geom_tag(g)) for g in table)
+        return {b: buckets_lib.warmup_batch(split, cfg, g, bs)
+                for b, g in enumerate(table)}
+    templates = {0: make_batch(split, np.arange(0), cfg, batch_size=bs)}
+    if prewarm:
+        # unbucketed: pre-warm the single-geometry program family too
+        # (prefill + no-op insert/step + harvest gather) — the dispatch
+        # watchdog depends on post-warmup dispatches never paying a
+        # first-use XLA compile (docs/FAULTS.md)
+        owner.prewarm([(templates[0], None)])
+    return templates
+
+
+def run_loop_guarded(loop: "ServeLoop", snapshot) -> ServeStats:
+    """Run the loop under the abort-flush contract: on ANY failure the
+    freshest partial metrics snapshot survives alongside the ordered
+    writer's .partial prefix (shared by both serve drivers)."""
+    try:
+        return loop.run()
+    except BaseException:
+        if snapshot is not None:
+            try:
+                snapshot(loop)
+            except Exception:
+                pass
+        raise
+
+
+def finalize_serve_result(stats: ServeStats, owner, faults, *,
+                          out_path: str, bleu_by_pos: Dict[int, float],
+                          metrics_path: Optional[str]) -> Dict:
+    """The serve drivers' shared tail: split-order BLEU aggregation, the
+    result dict, and the atomic final metrics artifact (+ .partial
+    cleanup) — one definition so the graphs-path and diffs-path
+    serve_metrics.json can never silently fork."""
+    n_done = len(bleu_by_pos)
+    total_bleu = sum(bleu_by_pos[p] for p in sorted(bleu_by_pos))
+    result = {
+        "sentence_bleu": total_bleu / max(n_done, 1),
+        "n": float(n_done),
+        "output_path": out_path,
+        "serve": stats.summary(),
+        "engine": owner.stats.summary(),
+        **({"faults": faults.summary()} if faults else {}),
+        "request_records": [dataclasses.asdict(r) for r in stats.records],
+    }
+    if metrics_path:
+        write_metrics_atomic(metrics_path, {
+            "serve": result["serve"],
+            "engine": result["engine"],
+            **({"faults": faults.summary()} if faults else {}),
+            "request_records": _json_safe_records(stats.records),
+        })
+        if os.path.exists(metrics_path + ".partial"):
+            os.remove(metrics_path + ".partial")
+        result["metrics_path"] = metrics_path
+    return result
+
+
+def metrics_snapshotter(metrics_path: Optional[str], owner, faults):
+    """The crash-contract partial-metrics hook both serve drivers pass
+    to ServeLoop (None when no metrics artifact is maintained)."""
+    if not metrics_path:
+        return None
+    partial_path = metrics_path + ".partial"
+
+    def snapshot(loop):
+        write_metrics_atomic(partial_path, {
+            "in_progress": True,
+            "serve": loop.stats.summary(),
+            "engine": owner.stats.summary(),
+            **({"faults": faults.summary()} if faults else {}),
+            "request_records": _json_safe_records(loop.stats.records),
+        })
+
+    return snapshot
 
 def _request_tasks(data, cfg: FiraConfig, n: int, table, assignment,
                    mix=None):
@@ -1086,13 +1285,8 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
     errs = serve_errors(cfg, trace=True)
     if errs:
         raise ValueError("; ".join(errs))
-    if clock == "wall":
-        clk = WallClock()
-    elif clock == "virtual":
-        clk = VirtualClock(step_cost_s=step_cost_s,
-                           prefill_cost_s=prefill_cost_s)
-    else:
-        raise ValueError(f"clock {clock!r} not in {{'wall', 'virtual'}}")
+    clk = make_clock(clock, step_cost_s=step_cost_s,
+                     prefill_cost_s=prefill_cost_s)
 
     if cfg.buckets:
         table = buckets_lib.decode_table(cfg)
@@ -1105,59 +1299,17 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
     else:
         table = assignment = None
 
-    bs = int(cfg.test_batch_size)
-    if engine is not None:
-        owner = engine
-        engines = getattr(owner, "engines", None) or [owner]
-    else:
-        n_rep = max(1, int(cfg.engine_replicas))
-        if n_rep > 1:
-            from fira_tpu.parallel import fleet as fleet_lib
-
-            owner = fleet_lib.EngineFleet(model, params, cfg,
-                                          replicas=n_rep,
-                                          slots=engine_slots, guard=guard,
-                                          faults=faults)
-            engines = owner.engines
-        else:
-            owner = SlotEngine(model, params, cfg, slots=engine_slots,
-                               guard=guard, faults=faults)
-            engines = [owner]
-    if table is not None:
-        if engine is None:
-            if guard is not None:
-                guard.declare(owner.labels(table))
-            owner.prewarm((buckets_lib.warmup_batch(data, cfg, g, bs),
-                           buckets_lib.geom_tag(g)) for g in table)
-        templates = {b: buckets_lib.warmup_batch(data, cfg, g, bs)
-                     for b, g in enumerate(table)}
-    else:
-        from fira_tpu.data.batching import make_batch
-
-        templates = {0: make_batch(data, np.arange(0), cfg, batch_size=bs)}
-        if engine is None:
-            # unbucketed: pre-warm the single-geometry program family too
-            # (prefill + no-op insert/step + harvest gather) — the
-            # dispatch watchdog depends on post-warmup dispatches never
-            # paying a first-use XLA compile (docs/FAULTS.md)
-            owner.prewarm([(templates[0], None)])
+    owner, engines, built = build_engines(model, params, cfg,
+                                          engine=engine,
+                                          engine_slots=engine_slots,
+                                          guard=guard, faults=faults)
+    templates = prepare_templates(owner, data, cfg, table, guard=guard,
+                                  prewarm=built)
 
     os.makedirs(out_dir, exist_ok=True)
     out_path = os.path.join(out_dir, output_name(ablation))
     bleu_by_pos: Dict[int, float] = {}
-
-    snapshot = None
-    if metrics_path:
-        partial_path = metrics_path + ".partial"
-
-        def snapshot(loop):
-            write_metrics_atomic(partial_path, {
-                "in_progress": True,
-                "serve": loop.stats.summary(),
-                "engine": owner.stats.summary(),
-                **({"faults": faults.summary()} if faults else {}),
-                "request_records": _json_safe_records(loop.stats.records),
-            })
+    snapshot = metrics_snapshotter(metrics_path, owner, faults)
 
     with OrderedStreamWriter(out_path, expected=n_req) as writer, \
             Feeder(_request_tasks(data, cfg, n_req, table, assignment, mix),
@@ -1179,36 +1331,7 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
             # line keeps the file position-complete and deterministic
             shed=lambda rec: writer.add(rec.position, "\n"),
             refill_order=refill_order, faults=faults, snapshot=snapshot)
-        try:
-            stats = loop.run()
-        except BaseException:
-            # abort flush: the freshest partial metrics snapshot survives
-            # the crash alongside the ordered writer's .partial prefix
-            if snapshot is not None:
-                try:
-                    snapshot(loop)
-                except Exception:
-                    pass
-            raise
-    n_done = len(bleu_by_pos)
-    total_bleu = sum(bleu_by_pos[p] for p in sorted(bleu_by_pos))
-    result = {
-        "sentence_bleu": total_bleu / max(n_done, 1),
-        "n": float(n_done),
-        "output_path": out_path,
-        "serve": stats.summary(),
-        "engine": owner.stats.summary(),
-        **({"faults": faults.summary()} if faults else {}),
-        "request_records": [dataclasses.asdict(r) for r in stats.records],
-    }
-    if metrics_path:
-        write_metrics_atomic(metrics_path, {
-            "serve": result["serve"],
-            "engine": result["engine"],
-            **({"faults": faults.summary()} if faults else {}),
-            "request_records": _json_safe_records(stats.records),
-        })
-        if os.path.exists(metrics_path + ".partial"):
-            os.remove(metrics_path + ".partial")
-        result["metrics_path"] = metrics_path
-    return result
+        stats = run_loop_guarded(loop, snapshot)
+    return finalize_serve_result(stats, owner, faults, out_path=out_path,
+                                 bleu_by_pos=bleu_by_pos,
+                                 metrics_path=metrics_path)

@@ -303,6 +303,102 @@ def smoke() -> int:
         **({"block_leaks": leaks[:3]} if leaks else {}),
     })
 
+    # --- raw-diff ingest legs (docs/INGEST.md): requests that arrive as
+    # unified-diff TEXT ride the same poison-request quarantine — a
+    # malformed diff and a faulted ingest.parse site must both shed with
+    # a recorded reason while every unaffected request's bytes equal the
+    # no-fault ingest run. (The ingest-vs-corpus byte equality itself is
+    # the serve_bench --ingest-smoke leg; here the contract under test
+    # is degradation.)
+    from fira_tpu.data.schema import Corpus
+    from fira_tpu.ingest.difftext import reconstruct_request
+    from fira_tpu.ingest.service import serve_diffs
+
+    corpus = Corpus.load(dataset.data_dir)
+    ing_reqs = [reconstruct_request(corpus.record(int(i)))
+                for i in dataset.split_indices["train"]]
+    ing_times = poisson_times(len(ing_reqs), rate=0.5, seed=3)
+    m_ing_ref = serve_diffs(model, params, dataset.word_vocab,
+                            dataset.ast_change_vocab, cfg,
+                            requests=ing_reqs, arrival_times=ing_times,
+                            out_dir=os.path.join(work, "ingest_ref"),
+                            clock="virtual")
+    ref_ing_lines = open(m_ing_ref["output_path"]).read().split("\n")
+
+    # malformed-diff leg: fixed positions replaced with garbage text —
+    # DiffParseError rides the error channel into the quarantine
+    bad_pos = {1, 7}
+    broken = list(ing_reqs)
+    for b in bad_pos:
+        broken[b] = "this is not a unified diff\n"
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        m = serve_diffs(model, params, dataset.word_vocab,
+                        dataset.ast_change_vocab, cfg, requests=broken,
+                        arrival_times=ing_times,
+                        out_dir=os.path.join(work, "ingest_malformed"),
+                        clock="virtual", guard=guard)
+        extra_compiles = guard.compiles_after_warmup()
+    got = open(m["output_path"]).read().split("\n")
+    sv = m["serve"]
+    shed_recs = {r["position"]: r for r in m["request_records"]
+                 if r["status"] == "shed_error"}
+    bad = [f"position {p} {why}" for p, why in
+           [(p, "not shed") for p in bad_pos if p not in shed_recs]
+           + [(p, "has no recorded parse error") for p in bad_pos
+              if p in shed_recs
+              and "DiffParseError" not in (shed_recs[p]["error"] or "")]
+           + [(p, "line not empty") for p in bad_pos if got[p] != ""]]
+    bad += [f"unaffected position {p} differs from no-fault"
+            for p in range(len(ing_reqs))
+            if p not in bad_pos and got[p] != ref_ing_lines[p]]
+    leg_ok = (sv["shed_error"] == len(bad_pos)
+              and sv["completed"] == len(ing_reqs) - len(bad_pos)
+              and not bad and extra_compiles == 0)
+    ok = ok and leg_ok
+    results.append({"leg": "ingest:malformed", "ok": leg_ok,
+                    "shed_error": sv["shed_error"],
+                    "completed": sv["completed"],
+                    "compiles_after_warmup": extra_compiles,
+                    **({"violations": bad[:3]} if bad else {})})
+
+    # ingest.parse fault legs: raise (quarantine sheds past the retry
+    # budget, unaffected bytes equal) and corrupt (a scrambled payload
+    # is a garbage REQUEST — served or shed, never a crash; blast
+    # radius is exactly the corrupted positions)
+    for kind, rate, seed_ in (("raise", 0.08, 7), ("corrupt", 0.08, 7)):
+        c = cfg.replace(inject_faults=f"ingest.parse:{kind}:{rate}:{seed_}")
+        inj = faults_lib.injector_from(c)
+        with sanitizer.sanitize(nans=False, infs=False) as guard:
+            m = serve_diffs(model, params, dataset.word_vocab,
+                            dataset.ast_change_vocab, c,
+                            requests=ing_reqs, arrival_times=ing_times,
+                            out_dir=os.path.join(work, f"ingest_{kind}"),
+                            clock="virtual", guard=guard, faults=inj)
+            extra_compiles = guard.compiles_after_warmup()
+        got = open(m["output_path"]).read().split("\n")
+        sv = m["serve"]
+        fired = sum(m.get("faults", {}).values())
+        accounted = (sv["completed"] + sv["shed_queue_full"]
+                     + sv["shed_deadline"] + sv["shed_error"])
+        if kind == "corrupt":
+            touched = set(inj.fired_keys.get("ingest.parse", []))
+            bad = [f"non-corrupted position {p} differs from no-fault"
+                   for p, (a, b) in enumerate(zip(ref_ing_lines, got))
+                   if p not in touched and a != b]
+        else:
+            bad = _check_degraded_bytes(ref_ing_lines, got,
+                                        m["request_records"])
+        leg_ok = (fired > 0 and accounted == len(ing_reqs) and not bad
+                  and extra_compiles == 0)
+        ok = ok and leg_ok
+        results.append({
+            "leg": f"ingest.parse:{kind}", "ok": leg_ok, "fired": fired,
+            "completed": sv["completed"], "shed_error": sv["shed_error"],
+            "retries": sv["request_retries"],
+            "compiles_after_warmup": extra_compiles,
+            **({"byte_violations": bad[:3]} if bad else {}),
+        })
+
     print(json.dumps({"smoke": "ok" if ok else "FAIL", "n_requests": n,
                       "legs": results}), flush=True)
     return 0 if ok else 1

@@ -12,13 +12,15 @@ echo "== firacheck: static JAX-hazard scan =="
 # fira_tpu/data/grouping.py, fira_tpu/decode/engine.py,
 # fira_tpu/decode/paging.py, fira_tpu/decode/prefix_cache.py,
 # fira_tpu/parallel/fleet.py,
-# fira_tpu/serve/server.py, fira_tpu/robust/faults.py and
+# fira_tpu/serve/server.py, fira_tpu/ingest/difftext.py,
+# fira_tpu/ingest/service.py, fira_tpu/robust/faults.py and
 # fira_tpu/robust/watchdog.py are named explicitly (as well as being
 # inside the fira_tpu tree, which the CLI dedupes): the async input
 # pipeline, the bucket packer, the grouped dispatch scheduler, the
 # slot-refill decode engine, the paged-KV arena geometry/validation, the
 # cross-request prefix cache, the replicated decode fleet, the
-# arrival-timed serving loop and the fault-injection/watchdog machinery
+# arrival-timed serving loop, the raw-diff ingest pipeline and the
+# fault-injection/watchdog machinery
 # are designated driver modules (astutil._DRIVER_FILES) whose
 # threaded/packing/refill/admission loops MUST stay in the self-scan
 # even if the directory arguments ever change.
@@ -27,7 +29,8 @@ JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu/data/grouping.py fira_tpu/decode/engine.py \
     fira_tpu/decode/paging.py fira_tpu/decode/prefix_cache.py \
     fira_tpu/parallel/fleet.py \
-    fira_tpu/serve/server.py fira_tpu/robust/faults.py \
+    fira_tpu/serve/server.py fira_tpu/ingest/difftext.py \
+    fira_tpu/ingest/service.py fira_tpu/robust/faults.py \
     fira_tpu/robust/watchdog.py tests scripts \
     || exit $?
 
@@ -50,6 +53,16 @@ echo "== prefix-cache smoke: duplicate-trace replay, cache on == cache off (docs
 # hits AND coalescing happening, and zero post-warmup compiles must hold
 # (cache lookups are host-side; no new program geometry exists).
 JAX_PLATFORMS=cpu python scripts/serve_bench.py --cache-smoke || exit $?
+
+echo "== ingest smoke: reconstructed-diff trace == corpus-path bytes (docs/INGEST.md) =="
+# The raw-diff ingest round trip stays machine-enforced in tier-1: a
+# fixed trace of diffs reconstructed from a pipeline-extracted corpus,
+# served end to end (--input diffs path) under the armed compile guard
+# — output bytes must equal the corpus-graph serve path's, every
+# request must complete with ingest stamps recorded, and zero
+# post-warmup retraces must hold (ingest is pure host work; no new
+# program geometry exists).
+JAX_PLATFORMS=cpu python scripts/serve_bench.py --ingest-smoke || exit $?
 
 echo "== chaos smoke: seeded fault at each site (docs/FAULTS.md) =="
 # The graceful-degradation contracts stay machine-enforced in tier-1:

@@ -50,6 +50,22 @@ Modes:
               guard: cache-on bytes == cache-off bytes with real hits +
               coalescing and zero post-warmup compiles (the check.sh
               leg). Exit nonzero on any violation.
+  --ingest    the RAW-DIFF leg (docs/INGEST_BENCH_r01.jsonl): serve a
+              trace of reconstructed unified diffs through the online
+              ingest pipeline (fira_tpu/ingest — per-request diff parse
+              + Java lexing + AST extraction + encode on the feeder
+              workers) at swept offered rates, next to the corpus-graph
+              path at the same rates: per-stage ingest latency, the
+              ingest-stall fraction (the feed-stall twin), and the
+              single-worker ingest rate vs the offline preprocessing
+              baseline (docs/PERF.md § Preprocessing, 1,815
+              commits/sec/core).
+  --ingest-smoke
+              fixed reconstructed-diff trace, virtual clock, armed
+              compile guard: ingest-path output bytes == corpus-path
+              bytes with every request completed + stamped and zero
+              post-warmup compiles (the check.sh leg). Exit nonzero on
+              any violation.
 
 Env knobs: FIRA_SERVE_COMMITS (synthetic corpus size, default 600),
 FIRA_SERVE_RATE_FRACS (default "0.25,0.5,0.8,1.2,1.6" x drain capacity),
@@ -78,6 +94,13 @@ sys.path.insert(0, REPO_ROOT)
 
 DEFAULT_OUT = os.path.join(REPO_ROOT, "docs", "SERVE_BENCH_r01.jsonl")
 DEFAULT_CACHE_OUT = os.path.join(REPO_ROOT, "docs", "CACHE_BENCH_r01.jsonl")
+DEFAULT_INGEST_OUT = os.path.join(REPO_ROOT, "docs",
+                                  "INGEST_BENCH_r01.jsonl")
+
+# the offline preprocessing baseline the online ingest rate is compared
+# against (docs/PERF.md § Preprocessing: host-side shard workers over
+# the full corpus, commits/sec/core)
+OFFLINE_PREPROCESS_RPS_PER_CORE = 1815.0
 
 
 def _repeat_mix(n: int, repeat: float, n_distinct: int, seed: int):
@@ -106,21 +129,27 @@ def _repeat_mix(n: int, repeat: float, n_distinct: int, seed: int):
 
 
 def _setup(n_commits: int, *, batch: int, slots: int, eos_delta: float,
-           buckets=()):
+           buckets=(), extracted: bool = False):
     """Synthetic corpus + tiny engine config + EOS-biased params (mixed
-    settle depths — the schedule the refill loop exists for)."""
+    settle depths — the schedule the refill loop exists for).
+    ``extracted``: build the corpus with
+    data.synthetic.write_extracted_corpus_dir (graph streams from the
+    REAL FSM + astdiff extraction — the round-trip corpus the ingest
+    legs need) instead of the random-graph writer."""
     import numpy as np
 
     from fira_tpu.config import fira_tiny
     from fira_tpu.data.batching import make_batch
     from fira_tpu.data.dataset import FiraDataset
-    from fira_tpu.data.synthetic import write_corpus_dir
+    from fira_tpu.data.synthetic import (write_corpus_dir,
+                                         write_extracted_corpus_dir)
     from fira_tpu.decode.beam import eos_biased_params
     from fira_tpu.model.model import FiraModel
     from fira_tpu.train.state import init_state
 
     data_dir = tempfile.mkdtemp(prefix="fira_serve_bench_")
-    write_corpus_dir(data_dir, n_commits=n_commits, seed=13)
+    writer = write_extracted_corpus_dir if extracted else write_corpus_dir
+    corpus = writer(data_dir, n_commits, seed=13)
     cfg = fira_tiny(batch_size=8, test_batch_size=batch,
                     decode_engine=True, engine_slots=slots,
                     buckets=buckets)
@@ -132,7 +161,7 @@ def _setup(n_commits: int, *, batch: int, slots: int, eos_delta: float,
     model = FiraModel(cfg)
     params = eos_biased_params(init_state(model, cfg, sample).params,
                                delta=eos_delta)
-    return dataset, cfg, model, params
+    return dataset, corpus, cfg, model, params
 
 
 def _serve_row(model, params, dataset, cfg, times, out_dir, **kw):
@@ -164,7 +193,7 @@ def measure(out_path: str) -> int:
     ab_fracs = [float(f) for f in os.environ.get(
         "FIRA_SERVE_AB_FRACS", "0.4,0.9").split(",")]
 
-    dataset, cfg, model, params = _setup(
+    dataset, _corpus, cfg, model, params = _setup(
         n_commits, batch=batch, slots=slots, eos_delta=eos_delta)
     data = dataset.splits["train"]
     n = len(data)
@@ -327,7 +356,7 @@ def cache_measure(out_path: str) -> int:
     repeats = [float(r) for r in os.environ.get(
         "FIRA_CACHE_REPEATS", "0,0.3,0.6").split(",")]
 
-    dataset, cfg, model, params = _setup(
+    dataset, _corpus, cfg, model, params = _setup(
         n_commits, batch=batch, slots=slots, eos_delta=eos_delta)
     data = dataset.splits["train"]
     n_distinct = len(data)
@@ -441,7 +470,7 @@ def cache_smoke() -> int:
     from fira_tpu.analysis import sanitizer
     from fira_tpu.serve import poisson_times, serve_split
 
-    dataset, cfg, model, params = _setup(
+    dataset, _corpus, cfg, model, params = _setup(
         40, batch=6, slots=6, eos_delta=4.0, buckets=((16, 400, 12),))
     n_distinct = len(dataset.splits["train"])
     n = 48
@@ -486,6 +515,198 @@ def cache_smoke() -> int:
     return 0 if ok else 1
 
 
+def _split_requests(dataset, corpus, split: str):
+    """The split's commits as reconstructed raw-diff request texts,
+    split order (request i = split position i — the corpus-path
+    alignment the byte-equality check depends on)."""
+    from fira_tpu.ingest.difftext import reconstruct_request
+
+    return [reconstruct_request(corpus.record(int(i)))
+            for i in dataset.split_indices[split]]
+
+
+def ingest_smoke() -> int:
+    """Fixed reconstructed-diff trace, virtual clock, armed compile
+    guard: the --input diffs path must serve BYTE-IDENTICAL output to
+    the corpus-graph path, complete every request with ingest stamps
+    recorded, and compile nothing after warmup. The check.sh tier-1
+    leg of the ingest round-trip contract (docs/INGEST.md)."""
+    import json as _json
+
+    from fira_tpu.analysis import sanitizer
+    from fira_tpu.ingest.service import serve_diffs
+    from fira_tpu.serve import poisson_times, serve_split
+
+    dataset, corpus, cfg, model, params = _setup(
+        40, batch=6, slots=6, eos_delta=4.0, buckets=((16, 400, 12),),
+        extracted=True)
+    n = len(dataset.splits["train"])
+    times = poisson_times(n, rate=0.5, seed=3)  # virtual-clock units
+    work = tempfile.mkdtemp(prefix="fira_ingest_smoke_")
+    var_path = os.path.join(dataset.data_dir, "variable.json")
+    with open(var_path) as f:
+        var_maps = _json.load(f)
+
+    ref = serve_split(model, params, dataset, cfg, arrival_times=times,
+                      out_dir=os.path.join(work, "graphs"), split="train",
+                      clock="virtual", var_maps=var_maps)
+    requests = _split_requests(dataset, corpus, "train")
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        m = serve_diffs(model, params, dataset.word_vocab,
+                        dataset.ast_change_vocab, cfg, requests=requests,
+                        arrival_times=times,
+                        out_dir=os.path.join(work, "diffs"),
+                        clock="virtual", guard=guard)
+        extra = guard.compiles_after_warmup()
+    got = open(m["output_path"], "rb").read()
+    exp = open(ref["output_path"], "rb").read()
+    sv = m["serve"]
+    ing = sv.get("ingest", {})
+    ok = (got == exp and extra == 0 and sv["completed"] == n
+          and sv["shed_error"] == 0
+          and ing.get("requests_ingested") == n
+          and ing.get("degraded") == 0)
+    print(json.dumps({
+        "smoke": "ok" if ok else "FAIL",
+        "bytes_equal_corpus_path": got == exp,
+        "compiles_after_warmup": extra,
+        "completed": sv["completed"], "offered": n,
+        "requests_ingested": ing.get("requests_ingested"),
+        "p50_ingest_total_s": ing.get("p50_total_s"),
+        "ingest_stall_frac": ing.get("stall_frac"),
+    }), flush=True)
+    return 0 if ok else 1
+
+
+def ingest_measure(out_path: str) -> int:
+    """The raw-diff serving leg (docs/INGEST_BENCH_r01.jsonl): drain
+    capacity anchor, then corpus-graph vs reconstructed-diff serving at
+    the same swept offered rates — per-stage ingest latency, the
+    ingest-stall fraction, and the single-worker ingest rate vs the
+    offline preprocessing baseline."""
+    from fira_tpu.data.feeder import Feeder
+    from fira_tpu.decode import engine as engine_lib
+    from fira_tpu.decode.runner import _decode_tasks
+    from fira_tpu.ingest.service import serve_diffs
+    from fira_tpu.serve import poisson_times
+
+    n_commits = int(os.environ.get("FIRA_INGEST_COMMITS", "300"))
+    batch = int(os.environ.get("FIRA_SERVE_BATCH", "8"))
+    slots = int(os.environ.get("FIRA_SERVE_SLOTS", "16"))
+    eos_delta = float(os.environ.get("FIRA_SERVE_EOS_DELTA", "4.0"))
+    seed = int(os.environ.get("FIRA_SERVE_SEED", "7"))
+    fracs = [float(f) for f in os.environ.get(
+        "FIRA_INGEST_RATE_FRACS", "0.5,0.8").split(",")]
+
+    dataset, corpus, cfg, model, params = _setup(
+        n_commits, batch=batch, slots=slots, eos_delta=eos_delta,
+        extracted=True)
+    data = dataset.splits["train"]
+    n = len(data)
+    requests = _split_requests(dataset, corpus, "train")
+    work = tempfile.mkdtemp(prefix="fira_ingest_out_")
+    # the graphs arm must de-anonymize with the corpus var maps exactly
+    # like the diffs arm does with its '#! var:' metadata, or the
+    # in-bench byte-equality gate fails on any decode that emits a
+    # placeholder token
+    with open(os.path.join(dataset.data_dir, "variable.json")) as f:
+        var_maps = json.load(f)
+
+    # drain capacity anchor (warm-then-measure, the serve_bench recipe)
+    eng = engine_lib.SlotEngine(model, params, cfg)
+
+    def drain_once():
+        tasks, _ = _decode_tasks(data, cfg)
+        with Feeder(tasks, num_workers=cfg.feeder_workers,
+                    depth=cfg.feeder_depth) as feed:
+            for _ in eng.run(feed):
+                pass
+
+    drain_once()
+    eng.stats = engine_lib.EngineStats(slots=eng.slots)
+    t0 = time.perf_counter()
+    drain_once()
+    drain_rps = eng.stats.commits / (time.perf_counter() - t0)
+    rows = [{
+        "mode": "ingest_anchor", "drain_rps": round(drain_rps, 3),
+        "n_requests": n, "slots": slots, "batch": batch,
+        "offline_preprocess_rps_per_core": OFFLINE_PREPROCESS_RPS_PER_CORE,
+        "host": "cpu-tiny (fira_tiny geometry; the ingest-vs-graphs "
+                "DELTAS and the stage split are the artifact, not "
+                "absolute numbers)",
+    }]
+
+    # one untimed warm pass per path (first-use costs off the timed rows)
+    warm_times = poisson_times(min(n, 4 * batch), drain_rps, seed=seed)
+    _serve_row(model, params, dataset, cfg, warm_times,
+               os.path.join(work, "warm_graphs"), engine=eng,
+               var_maps=var_maps)
+    serve_diffs(model, params, dataset.word_vocab,
+                dataset.ast_change_vocab, cfg,
+                requests=requests[: len(warm_times)],
+                arrival_times=warm_times,
+                out_dir=os.path.join(work, "warm_diffs"), engine=eng)
+
+    for frac in fracs:
+        rate = frac * drain_rps
+        times = poisson_times(n, rate, seed=seed)
+        # corpus-graph reference at the same rate (the decode-only arm)
+        eng.stats = engine_lib.EngineStats(slots=eng.slots)
+        sv_g, _m = _serve_row(model, params, dataset, cfg, times,
+                              os.path.join(work, f"g{frac}"), engine=eng,
+                              var_maps=var_maps)
+        # raw-diff arm: same engine, payloads from the ingest pipeline
+        eng.stats = engine_lib.EngineStats(slots=eng.slots)
+        t0 = time.perf_counter()
+        m = serve_diffs(model, params, dataset.word_vocab,
+                        dataset.ast_change_vocab, cfg, requests=requests,
+                        arrival_times=times,
+                        out_dir=os.path.join(work, f"d{frac}"), engine=eng)
+        wall = time.perf_counter() - t0
+        sv = m["serve"]
+        ing = sv["ingest"]
+        total_ingest_s = sum(
+            sum(r["ingest"].get(k, 0.0)
+                for k in ("lex_s", "parse_s", "assemble_s"))
+            for r in m["request_records"] if r.get("ingest"))
+        ingest_rps_1w = n / total_ingest_s if total_ingest_s else None
+        bytes_equal = (
+            open(m["output_path"], "rb").read()
+            == open(_m["output_path"], "rb").read())
+        rows.append({
+            "mode": "ingest_sweep", "rate_frac": round(frac, 3),
+            "offered_rps": round(rate, 3), "wall_s": round(wall, 3),
+            "bytes_equal_graphs_path": bytes_equal,
+            "completed": sv["completed"],
+            "throughput_rps": sv["throughput_rps"],
+            "p50_e2e_s": sv["p50_e2e_s"], "p99_e2e_s": sv["p99_e2e_s"],
+            "graphs_throughput_rps": sv_g["throughput_rps"],
+            "graphs_p50_e2e_s": sv_g["p50_e2e_s"],
+            "mean_lex_s": ing["mean_lex_s"],
+            "mean_parse_s": ing["mean_parse_s"],
+            "mean_assemble_s": ing["mean_assemble_s"],
+            "p50_ingest_total_s": ing["p50_total_s"],
+            "p99_ingest_total_s": ing["p99_total_s"],
+            "ingest_stall_s": ing["stall_s"],
+            "ingest_stall_frac": ing["stall_frac"],
+            "ingest_rps_single_worker": (round(ingest_rps_1w, 1)
+                                         if ingest_rps_1w else None),
+            "vs_offline_preprocess": (
+                round(ingest_rps_1w / OFFLINE_PREPROCESS_RPS_PER_CORE, 4)
+                if ingest_rps_1w else None),
+        })
+
+    stamp = {"generated_by": "scripts/serve_bench.py --ingest",
+             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    with open(out_path, "w") as f:
+        f.write(json.dumps(stamp) + "\n")
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    print(json.dumps({"rows": rows, "out": out_path}), flush=True)
+    ok = all(r.get("bytes_equal_graphs_path", True) for r in rows)
+    return 0 if ok else 1
+
+
 def smoke() -> int:
     """Fixed-trace virtual-clock replay under the armed compile guard:
     serve bytes == drain bytes, zero post-warmup compiles, everything
@@ -494,7 +715,7 @@ def smoke() -> int:
     from fira_tpu.decode.runner import run_test
     from fira_tpu.serve import poisson_times
 
-    dataset, cfg, model, params = _setup(
+    dataset, _corpus, cfg, model, params = _setup(
         40, batch=6, slots=6, eos_delta=4.0, buckets=((16, 400, 12),))
     n = len(dataset.splits["train"])
     times = poisson_times(n, rate=0.5, seed=3)  # virtual-clock units
@@ -533,9 +754,16 @@ def main() -> int:
     ap.add_argument("--cache-smoke", action="store_true",
                     help="duplicate-trace cache-on == cache-off bytes leg "
                          "(scripts/check.sh)")
+    ap.add_argument("--ingest", action="store_true",
+                    help="raw-diff serving leg "
+                         "(docs/INGEST_BENCH_r01.jsonl)")
+    ap.add_argument("--ingest-smoke", action="store_true",
+                    help="reconstructed-diff trace == corpus-path bytes "
+                         "leg (scripts/check.sh)")
     ap.add_argument("--out", default=None,
                     help=f"JSONL record path (default {DEFAULT_OUT}; "
-                         f"{DEFAULT_CACHE_OUT} with --cache)")
+                         f"{DEFAULT_CACHE_OUT} with --cache; "
+                         f"{DEFAULT_INGEST_OUT} with --ingest)")
     args = ap.parse_args()
 
     from fira_tpu.utils.backend_guard import force_cpu_backend
@@ -545,8 +773,12 @@ def main() -> int:
         return smoke()
     if args.cache_smoke:
         return cache_smoke()
+    if args.ingest_smoke:
+        return ingest_smoke()
     if args.cache:
         return cache_measure(args.out or DEFAULT_CACHE_OUT)
+    if args.ingest:
+        return ingest_measure(args.out or DEFAULT_INGEST_OUT)
     return measure(args.out or DEFAULT_OUT)
 
 

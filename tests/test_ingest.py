@@ -1,0 +1,374 @@
+"""Online raw-diff ingest (fira_tpu/ingest — docs/INGEST.md).
+
+The load-bearing contract is the ROUND TRIP: a corpus commit's
+reconstructed unified diff pushed through ingest must yield (a) the
+exact corpus (difftoken, diffmark) streams back, (b) a wire payload
+byte-identical to the frozen corpus path's ``make_batch`` row, and
+(c) byte-identical served output through the full serving loop — plus
+the degradation edges: malformed diffs recorded-shed (never a crash),
+OOV tokens encoded to UNK/PAD, over-budget diffs deterministically
+truncated (or shed by policy), and parse-time knob validation at CLI
+exit 2.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fira_tpu import cli
+from fira_tpu.config import fira_tiny
+from fira_tpu.data.batching import make_batch
+from fira_tpu.data.dataset import FiraDataset
+from fira_tpu.data.synthetic import (generate_corpus,
+                                     write_extracted_corpus_dir)
+from fira_tpu.data.vocab import UNK_ID
+from fira_tpu.ingest.difftext import (DiffParseError, parse_request,
+                                      read_diff_trace, reconstruct_diff,
+                                      reconstruct_request,
+                                      write_diff_trace)
+from fira_tpu.ingest.service import (IngestError, ingest_errors,
+                                     ingest_record, ingest_request)
+
+
+# --------------------------------------------------------------------------
+# text round trip (no model, no astdiff extraction)
+# --------------------------------------------------------------------------
+
+def test_parse_reconstruct_roundtrip_on_corpus_streams():
+    """parse(reconstruct(streams)) == streams for every synthetic commit:
+    the precondition of every downstream equivalence claim."""
+    corpus = generate_corpus(40, seed=13)
+    for i in range(len(corpus)):
+        rec = corpus.record(i)
+        req = parse_request(reconstruct_request(rec))
+        assert req.tokens == rec.diff_tokens, f"commit {i} tokens"
+        assert req.marks == rec.diff_marks, f"commit {i} marks"
+        assert req.msg_tokens == rec.msg_tokens, f"commit {i} msg"
+        assert req.var_map == rec.var_map, f"commit {i} var"
+
+
+def test_malformed_diffs_raise_named_errors():
+    with pytest.raises(DiffParseError):
+        parse_request("this is not a diff\n")
+    with pytest.raises(DiffParseError):   # body line before any hunk
+        parse_request("+int x = 1 ;\n")
+    with pytest.raises(DiffParseError):   # nothing after the headers
+        parse_request("diff --git a/F b/F\n--- a/F\n+++ b/F\n")
+    with pytest.raises(DiffParseError):   # broken metadata
+        parse_request("#! var: not-json\n@@ -1,1 +1,1 @@\n+int x ;\n")
+    with pytest.raises(DiffParseError):
+        parse_request("")
+
+
+def test_reconstruct_rejects_unrepresentable_streams():
+    with pytest.raises(ValueError):
+        reconstruct_diff(["<nb>", "<nl>"], [2, 2])   # empty header block
+    with pytest.raises(ValueError):
+        reconstruct_diff(["<nl>"], [2])              # stray <nl>
+    with pytest.raises(ValueError):
+        reconstruct_diff(["x"], [7])                 # bad mark
+
+
+def test_roundtrip_survives_header_lookalike_tokens():
+    """A deletion run starting with '--' (or addition with '++') must not
+    render as a '---'/'+++' file-header line on re-parse: reconstruction
+    separates marker from content, and header prefixes are only honored
+    OUTSIDE hunks — git's own positional disambiguation."""
+    tokens = ["x", "=", "1", ";", "--", "count", ";", "++", "n", ";"]
+    marks = [2, 2, 2, 2, 1, 1, 1, 3, 3, 3]
+    req = parse_request(reconstruct_diff(tokens, marks))
+    assert req.tokens == tokens
+    assert req.marks == marks
+
+
+def test_multi_file_diff_headers_parse_positionally():
+    """'--- ' between two files' sections is a header; '--- ' INSIDE a
+    hunk is a deletion whose content starts with '--'."""
+    raw = (
+        "diff --git a/A.java b/A.java\n--- a/A.java\n+++ b/A.java\n"
+        "@@ -1,1 +1,1 @@ class A\n"
+        "--- count ;\n"                      # deletion: tokens -- count ;
+        "diff --git a/B.java b/B.java\n--- a/B.java\n+++ b/B.java\n"
+        "@@ -2,1 +2,1 @@ class B\n"
+        "+int y ;\n"
+    )
+    req = parse_request(raw)
+    assert req.tokens == ["<nb>", "class", "A", "<nl>", "--", "count", ";",
+                          "<nb>", "class", "B", "<nl>", "int", "y", ";"]
+    assert req.marks == [2, 2, 2, 2, 1, 1, 1, 2, 2, 2, 2, 3, 3, 3]
+
+
+def test_diff_trace_file_and_directory(tmp_path):
+    corpus = generate_corpus(4, seed=1)
+    reqs = [reconstruct_request(corpus.record(i)) for i in range(4)]
+    path = write_diff_trace(str(tmp_path / "reqs.trace"), reqs)
+    assert read_diff_trace(path) == [r if r.endswith("\n") else r + "\n"
+                                     for r in reqs]
+    d = tmp_path / "dir"
+    d.mkdir()
+    for i, r in enumerate(reqs):
+        (d / f"{i:03d}.diff").write_text(r)
+    assert read_diff_trace(str(d)) == reqs
+    # content BEFORE the first separator is request 0, never dropped
+    headless = tmp_path / "headless.trace"
+    headless.write_text(reqs[0] + "#! request 1\n" + reqs[1])
+    got = read_diff_trace(str(headless))
+    assert len(got) == 2 and got[0] == reqs[0]
+
+
+# --------------------------------------------------------------------------
+# wire-payload round trip vs the frozen corpus path
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def extracted(tmp_path_factory):
+    """A pipeline-extracted corpus (graph streams from the REAL FSM +
+    astdiff extraction — the round-trip corpus) + its frozen dataset."""
+    d = str(tmp_path_factory.mktemp("ingest_corpus"))
+    corpus = write_extracted_corpus_dir(d, 24, seed=13)
+    cfg = fira_tiny(batch_size=8, test_batch_size=4, engine_slots=4)
+    dataset = FiraDataset(d, cfg)
+    return corpus, dataset, dataset.cfg
+
+
+def test_wire_payload_bytes_identical_to_corpus_path(extracted):
+    corpus, dataset, cfg = extracted
+    split = dataset.splits["train"]
+    idx = dataset.split_indices["train"]
+    for pos in range(min(len(split), 8)):
+        ref = make_batch(split, np.asarray([pos]), cfg, batch_size=1)
+        text = reconstruct_request(corpus.record(int(idx[pos])))
+        got = ingest_request(text, dataset.word_vocab,
+                             dataset.ast_change_vocab, cfg)
+        for k in ref:
+            a, b = np.asarray(ref[k]), np.asarray(got[k])
+            assert a.dtype == b.dtype and a.shape == b.shape \
+                and a.tobytes() == b.tobytes(), f"sample {pos} field {k}"
+        st = got["_ingest"]
+        assert st["truncated"] is None and st["degraded"] is None
+        assert st["oov_ast"] == 0
+
+
+def test_bucketed_payload_and_assignment_match_corpus_path(extracted):
+    from fira_tpu.data import buckets as buckets_lib
+
+    corpus, dataset, cfg = extracted
+    cfg = cfg.replace(buckets=((16, 400, 12),))
+    split = dataset.splits["train"]
+    idx = dataset.split_indices["train"]
+    table = buckets_lib.decode_table(cfg)
+    ext = buckets_lib.sample_extents(split, cfg)
+    assign = buckets_lib.assign_buckets(ext, table, use_msg=False)
+    assert len(set(assign[: min(len(split), 8)].tolist())) > 1, \
+        "fixture must exercise more than one bucket"
+    for pos in range(min(len(split), 8)):
+        g = table[int(assign[pos])]
+        ref = make_batch(split, np.asarray([pos]), cfg, batch_size=1,
+                         geom=g)
+        text = reconstruct_request(corpus.record(int(idx[pos])))
+        got = ingest_request(text, dataset.word_vocab,
+                             dataset.ast_change_vocab, cfg, table=table)
+        assert got["_bucket"] == int(assign[pos]), f"sample {pos} bucket"
+        for k in ref:
+            a, b = np.asarray(ref[k]), np.asarray(got[k])
+            assert a.tobytes() == b.tobytes(), f"sample {pos} field {k}"
+
+
+def test_referenceless_diff_reserves_full_tar_budget(extracted):
+    """With decode_tar_buckets on, the tar bucket is a GENERATION cap
+    keyed off the reference-message extent; a real request with no
+    '#! msg:' metadata has no such proxy and must reserve the full tar
+    budget — never a silent output clip at a small bucket's tar."""
+    from fira_tpu.data import buckets as buckets_lib
+
+    corpus, dataset, cfg = extracted
+    cfg = cfg.replace(buckets=((16, 400, 4),), decode_tar_buckets=True)
+    table = buckets_lib.decode_table(cfg)
+    assert table[0].tar_len < cfg.tar_len   # a clipping bucket exists
+    idx = dataset.split_indices["train"]
+    text = reconstruct_request(corpus.record(int(idx[0])))
+    with_msg = ingest_request(text, dataset.word_vocab,
+                              dataset.ast_change_vocab, cfg, table=table)
+    bare = "\n".join(ln for ln in text.splitlines()
+                     if not ln.startswith("#!")) + "\n"
+    no_msg = ingest_request(bare, dataset.word_vocab,
+                            dataset.ast_change_vocab, cfg, table=table)
+    assert table[no_msg["_bucket"]].tar_len == cfg.tar_len
+    # the reference-carrying request still packs by its message extent
+    assert with_msg["_bucket"] == 0 or \
+        table[with_msg["_bucket"]].tar_len == cfg.tar_len
+
+
+def test_oov_tokens_encode_never_crash(extracted):
+    """A diff full of identifiers/AST shapes the frozen vocabs never saw
+    encodes deterministically: unknown words -> <unkm>, unknown AST
+    labels -> <pad> (counted), no exception anywhere."""
+    _corpus, dataset, cfg = extracted
+    raw = (
+        "diff --git a/src/Foo.java b/src/Foo.java\n"
+        "--- a/src/Foo.java\n+++ b/src/Foo.java\n"
+        "@@ -10,4 +10,4 @@ class WeirdNewClazz\n"
+        " public void frobnicateWidget ( ) {\n"
+        "-int legacyCounterXyz = 42 ;\n"
+        "+for ( int qq = 0 ; qq < 9 ; qq ++ ) { zorp ( qq ) ; }\n"
+        " }\n"
+    )
+    got = ingest_request(raw, dataset.word_vocab,
+                         dataset.ast_change_vocab, cfg)
+    assert bool(got["valid"][0])
+    assert UNK_ID in got["diff"][0].tolist()   # unseen words -> <unkm>
+    assert got["_ingest"]["oov_words"] > 0     # ...and counted
+    assert got["_ingest"]["degraded"] is None
+
+
+def test_overbudget_truncation_policy(extracted):
+    """An over-budget diff truncates deterministically under 'clip'
+    (recorded, payload admissible at full geometry) and raises under
+    'shed' — never a make_batch admissibility backstop."""
+    _corpus, dataset, cfg = extracted
+    body = "".join(f"+int var{i} = {i} ;\n" for i in range(cfg.sou_len))
+    raw = ("diff --git a/F.java b/F.java\n--- a/F.java\n+++ b/F.java\n"
+           "@@ -1,1 +1,1 @@ class Big\n" + body)
+    got = ingest_request(raw, dataset.word_vocab,
+                         dataset.ast_change_vocab, cfg)
+    st = got["_ingest"]
+    assert st["truncated"] and st["truncated"]["diff_tokens_dropped"] > 0
+    assert got["diff"].shape == (1, cfg.sou_len)   # assembled, in budget
+    with pytest.raises(IngestError):
+        ingest_request(raw, dataset.word_vocab, dataset.ast_change_vocab,
+                       cfg.replace(ingest_truncate="shed"))
+    # a truncation cut landing inside a header block backs off past it
+    req = parse_request(raw)
+    toks = req.tokens[: cfg.sou_len - 4] + ["<nb>", "class", "X", "<nl>"]
+    marks = req.marks[: cfg.sou_len - 4] + [2, 2, 2, 2]
+    rec, info = ingest_record(dataclasses.replace(req, tokens=toks,
+                                                  marks=marks), cfg)
+    assert "<nb>" not in rec.diff_tokens[cfg.sou_len - 4:]
+    assert info["truncated"]["diff_tokens_dropped"] >= 4
+
+
+# --------------------------------------------------------------------------
+# end-to-end serving equivalence + quarantine
+# --------------------------------------------------------------------------
+
+def test_serve_diffs_bytes_identical_and_malformed_shed(extracted,
+                                                        tmp_path):
+    """One serve run per side: reconstructed-diff serving produces
+    byte-identical output to the corpus-graph path with ingest stamps in
+    the metrics artifact; then a trace with malformed requests sheds
+    exactly those positions (recorded reason + empty line) while every
+    other byte matches."""
+    from fira_tpu.decode.beam import eos_biased_params
+    from fira_tpu.ingest.service import serve_diffs
+    from fira_tpu.model.model import FiraModel
+    from fira_tpu.serve import poisson_times, serve_split
+    from fira_tpu.train.state import init_state
+
+    corpus, dataset, cfg = extracted
+    cfg = cfg.replace(decode_engine=True)
+    split = dataset.splits["train"]
+    n = len(split)
+    sample = make_batch(split, np.arange(min(4, n)), cfg, batch_size=4)
+    model = FiraModel(cfg)
+    params = eos_biased_params(init_state(model, cfg, sample).params,
+                               delta=4.0)
+    times = poisson_times(n, 0.7, seed=3)
+    var_maps = json.load(open(os.path.join(dataset.data_dir,
+                                           "variable.json")))
+    ref = serve_split(model, params, dataset, cfg, arrival_times=times,
+                      out_dir=str(tmp_path / "graphs"), split="train",
+                      clock="virtual", var_maps=var_maps)
+    idx = dataset.split_indices["train"]
+    requests = [reconstruct_request(corpus.record(int(i))) for i in idx]
+    metrics_path = str(tmp_path / "diffs" / "serve_metrics.json")
+    m = serve_diffs(model, params, dataset.word_vocab,
+                    dataset.ast_change_vocab, cfg, requests=requests,
+                    arrival_times=times, out_dir=str(tmp_path / "diffs"),
+                    clock="virtual", metrics_path=metrics_path)
+    ref_bytes = open(ref["output_path"], "rb").read()
+    assert open(m["output_path"], "rb").read() == ref_bytes
+    assert m["serve"]["completed"] == n
+    art = json.load(open(metrics_path))
+    assert art["serve"]["ingest"]["requests_ingested"] == n
+    assert all(r["ingest"] is not None for r in art["request_records"])
+
+    # malformed requests ride the quarantine: recorded shed + empty line,
+    # unaffected positions byte-identical
+    broken = list(requests)
+    bad = {1, min(5, n - 1)}
+    for b in bad:
+        broken[b] = "garbage that is not a diff\n"
+    m2 = serve_diffs(model, params, dataset.word_vocab,
+                     dataset.ast_change_vocab, cfg, requests=broken,
+                     arrival_times=times, out_dir=str(tmp_path / "bad"),
+                     clock="virtual")
+    assert m2["serve"]["completed"] == n - len(bad)
+    assert m2["serve"]["shed_error"] == len(bad)
+    got_lines = open(m2["output_path"]).read().split("\n")
+    ref_lines = ref_bytes.decode().split("\n")
+    for pos in range(n):
+        if pos in bad:
+            assert got_lines[pos] == ""
+            rec = m2["request_records"][pos]
+            assert rec["status"] == "shed_error"
+            assert "DiffParseError" in rec["error"]
+        else:
+            assert got_lines[pos] == ref_lines[pos], f"position {pos}"
+
+
+# --------------------------------------------------------------------------
+# knob validation (parse time, CLI exit 2) + fault-site registration
+# --------------------------------------------------------------------------
+
+def test_ingest_knob_validation_messages():
+    cfg = fira_tiny()
+    assert ingest_errors(cfg) == []
+    assert any("ingest_workers" in e for e in
+               ingest_errors(cfg.replace(ingest_workers=-1)))
+    assert any("ingest_truncate" in e for e in
+               ingest_errors(cfg.replace(ingest_truncate="bogus")))
+    assert any("--diff-trace" in e for e in
+               ingest_errors(cfg, input_mode="diffs", diff_trace=None))
+    assert any("does not exist" in e for e in
+               ingest_errors(cfg, input_mode="diffs",
+                             diff_trace="/no/such/path"))
+    assert any("--diff-trace only applies" in e for e in
+               ingest_errors(cfg, input_mode="graphs",
+                             diff_trace="/etc/hostname"))
+
+
+def test_cli_exit_2_on_bad_ingest_knobs(tmp_path):
+    assert cli.main(["serve", "--input", "diffs", "--serve-rate", "1"]) == 2
+    assert cli.main(["serve", "--input", "diffs", "--serve-rate", "1",
+                     "--diff-trace", "/no/such/path"]) == 2
+    assert cli.main(["serve", "--serve-rate", "1",
+                     "--diff-trace", "/etc/hostname"]) == 2
+    assert cli.main(["serve", "--serve-rate", "1",
+                     "--ingest-workers", "-1"]) == 2
+    assert cli.main(["message"]) == 2
+    assert cli.main(["message", "/no/such/file.diff"]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli.main(["serve", "--input", "diffs", "--serve-rate", "1",
+                     "--diff-trace", str(empty)]) == 2
+    # an EMPTY trace file is a parse-time exit 2, not a post-checkpoint
+    # traceback
+    empty_file = tmp_path / "empty.trace"
+    empty_file.write_text("")
+    assert cli.main(["serve", "--input", "diffs", "--serve-rate", "1",
+                     "--diff-trace", str(empty_file)]) == 2
+
+
+def test_ingest_parse_is_a_registered_fault_site():
+    from fira_tpu.robust.faults import (CORRUPT_SITES, SITES,
+                                        parse_fault_specs, robust_errors)
+
+    assert "ingest.parse" in SITES
+    assert "ingest.parse" in CORRUPT_SITES
+    specs = parse_fault_specs("ingest.parse:corrupt:0.5:1")
+    assert specs[0].site == "ingest.parse"
+    cfg = fira_tiny(inject_faults="ingest.parse:raise:0.1:7")
+    assert robust_errors(cfg) == []
