@@ -139,9 +139,12 @@ record; the full artifact lands in docs/SERVE_BENCH_r01.jsonl.
 FIRA_BENCH_SERVE_TIMEOUT caps the sweep, default 900 s),
 FIRA_BENCH_CHAOS=1 (opt-in chaos leg: runs scripts/chaos_bench.py —
 throughput / shed-rate / retirement rows under seeded injected fault
-rates through the serving loop, fira_tpu/robust (docs/FAULTS.md) — and
-folds its rows into this record; the full artifact lands in
-docs/CHAOS_BENCH_r01.jsonl. FIRA_BENCH_CHAOS_TIMEOUT caps the sweep,
+rates through the serving loop, fira_tpu/robust (docs/FAULTS.md), plus
+the recovery rows (capacity-restored-over-time with replica respawn
+armed, write-ahead-journal / SIGKILL-resume overhead — docs/FAULTS.md
+"Recovery contracts") — and folds all rows into this record; the full
+artifacts land in docs/CHAOS_BENCH_r01.jsonl and
+docs/CHAOS_BENCH_r02.jsonl. FIRA_BENCH_CHAOS_TIMEOUT caps the sweep,
 default 900 s),
 FIRA_BENCH_CACHE=1 (opt-in repeated-traffic leg: runs
 scripts/serve_bench.py --cache — prefix cache + in-flight dedup on vs
@@ -841,10 +844,14 @@ def worker() -> None:
                                  "FIRA_BENCH_SERVE_TIMEOUT")
 
     # (h) CHAOS leg (opt-in: FIRA_BENCH_CHAOS=1): graceful degradation
-    # under injected fault rates — scripts/chaos_bench.py serves the same
-    # open-loop stream with seeded faults armed at increasing rates and
-    # records throughput, shed rate, retirements, and requeues per rate
-    # (fira_tpu/robust; docs/FAULTS.md).
+    # AND recovery under injected fault rates — scripts/chaos_bench.py
+    # serves the same open-loop stream with seeded faults armed at
+    # increasing rates and records throughput, shed rate, retirements,
+    # and requeues per rate (docs/CHAOS_BENCH_r01.jsonl), then the
+    # self-healing rows: capacity-restored-over-time with replica
+    # respawn armed vs PR-9 degrade, and the write-ahead-journal /
+    # SIGKILL-resume overhead (docs/CHAOS_BENCH_r02.jsonl;
+    # fira_tpu/robust; docs/FAULTS.md "Recovery contracts").
     chaos = None
     if os.environ.get("FIRA_BENCH_CHAOS", "0") == "1":
         chaos = _script_rows_leg("chaos", "chaos_bench.py",

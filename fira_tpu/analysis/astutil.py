@@ -58,6 +58,7 @@ _DRIVER_FILES = (
     "fira_tpu/ingest/service.py",
     "fira_tpu/robust/faults.py",
     "fira_tpu/robust/watchdog.py",
+    "fira_tpu/robust/recovery.py",
 )
 
 

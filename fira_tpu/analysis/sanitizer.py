@@ -110,6 +110,16 @@ class CompileGuard:
         and additive — train and decode each declare their own labels."""
         self._declared = (self._declared or set()) | set(labels)
 
+    @property
+    def family_closed(self) -> bool:
+        """True once declare() has closed the program family. Mid-run
+        label additions (a respawned replica's fresh program set —
+        robust/recovery.py) must declare ADDITIVELY into a closed family
+        and must never be the FIRST declare: closing an open family
+        around only the replacement's labels would outlaw every
+        already-serving program."""
+        return self._declared is not None
+
     def step_counting(self, label: str) -> int:
         """Attribute compilations since the last call to ``label``'s
         current dispatch and record them; returns the number of

@@ -280,6 +280,41 @@ def build_parser() -> argparse.ArgumentParser:
                         "before it is shed with a recorded error and an "
                         "empty output line (default 1; >= 0, validated "
                         "at parse time, exit 2)")
+    p.add_argument("--max-respawns", type=int, default=None, metavar="N",
+                   help="self-healing fleet (docs/FAULTS.md 'Recovery "
+                        "contracts'): replacement budget per replica "
+                        "lineage — a retired replica is respawned (fresh "
+                        "engine on its device, prewarmed through the "
+                        "declared family, or a warm spare attached) up "
+                        "to N times before the lineage degrades "
+                        "permanently. 0 = off (default, the retire-and-"
+                        "degrade behavior); >= 0, validated at parse "
+                        "time, exit 2")
+    p.add_argument("--engine-spares", type=int, default=None, metavar="N",
+                   help="warm-spare pool: N pre-built prewarmed standby "
+                        "engines a retirement attaches in O(1) instead "
+                        "of paying a mid-run build + compile; counts "
+                        "against --max-respawns on attach (requires "
+                        "--max-respawns >= 1; >= 0, validated at parse "
+                        "time, exit 2)")
+    p.add_argument("--respawn-backoff-s", type=float, default=None,
+                   metavar="S",
+                   help="respawn backoff base in wall seconds: a crash-"
+                        "looping lineage waits the shared backoff curve "
+                        "(linear in the attempt, capped at 5x) rescaled "
+                        "to S between replacements (default 0.25; > 0, "
+                        "validated at parse time, exit 2)")
+    p.add_argument("--resume", action="store_true",
+                   help="serve: resume a killed run from its write-ahead "
+                        "request journal (<out>/output_fira*.journal) + "
+                        "the ordered writer's crash pair — only the "
+                        "not-yet-done suffix is re-served and the final "
+                        "output file is byte-identical to an "
+                        "uninterrupted run (exactly-once output; "
+                        "docs/FAULTS.md 'Recovery contracts'). Requires "
+                        "an existing journal from a prior `serve` run "
+                        "with the same trace/seed/rate (validated at "
+                        "parse time, exit 2)")
     p.add_argument("--beam-log-space", action="store_true",
                    help="log-space beam accumulation instead of the "
                         "reference-compat probability space")
@@ -441,6 +476,12 @@ def _resolve_cfg(args):
         overrides["dispatch_watchdog_s"] = args.dispatch_watchdog_s
     if args.robust_retries is not None:
         overrides["robust_retries"] = args.robust_retries
+    if args.max_respawns is not None:
+        overrides["max_respawns"] = args.max_respawns
+    if args.engine_spares is not None:
+        overrides["engine_spares"] = args.engine_spares
+    if args.respawn_backoff_s is not None:
+        overrides["respawn_backoff_s"] = args.respawn_backoff_s
     if args.adjacency:
         overrides["adjacency_impl"] = args.adjacency
     if args.encoder_buffer:
@@ -520,6 +561,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         ingest_errs = ingest_errors(cfg, input_mode=args.input,
                                     diff_trace=args.diff_trace,
                                     command=args.command)
+        if args.command == "serve" and args.input == "diffs" \
+                and (cfg.max_respawns > 0 or cfg.engine_spares > 0):
+            # the raw-diff serve path has no recovery wiring yet: knobs
+            # that LOOK armed but silently do nothing are worse than a
+            # named rejection
+            ingest_errs.append(
+                "max_respawns/engine_spares support --input graphs only "
+                "(the raw-diff serve path has no respawn wiring yet)")
+        if args.command == "serve" and args.resume:
+            # --resume admission (docs/FAULTS.md "Recovery contracts"):
+            # a resume without a prior run's journal is a named exit-2
+            # error, never a mid-run crash; the raw-diff path keeps no
+            # journal yet, so resuming it is rejected up front too
+            from fira_tpu.decode.runner import output_name as _oname
+
+            journal = os.path.join(args.out_dir,
+                                   _oname(args.ablation) + ".journal")
+            if args.input == "diffs":
+                ingest_errs.append(
+                    "--resume supports --input graphs only (the raw-diff "
+                    "serve path keeps no request journal yet)")
+            elif not os.path.exists(journal):
+                from fira_tpu.robust.recovery import missing_journal_error
+
+                ingest_errs.append(missing_journal_error(journal))
         if args.command == "message":
             if not args.target:
                 ingest_errs.append(
@@ -609,6 +675,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     from fira_tpu.robust.faults import robust_errors
 
     errs += robust_errors(cfg)
+    # self-healing knob admission (spare count, respawn budget, backoff
+    # base) — same exit-2 contract, robust.recovery.recovery_errors
+    from fira_tpu.robust.recovery import recovery_errors
+
+    errs += recovery_errors(cfg)
     if errs:
         for e in errs:
             print(f"parse-time validation: {e}", file=sys.stderr)
@@ -719,6 +790,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         # atomically at completion — the ordered writer's crash contract
         # applied to metrics (docs/FAULTS.md)
         metrics_path = os.path.join(args.out_dir, "serve_metrics.json")
+        # write-ahead request journal (robust/recovery.py): every graphs
+        # serve run keeps one next to its output, so ANY run is
+        # resumable after a hard kill; --resume additionally validates
+        # the journal pins the SAME request stream (count + arrival
+        # digest) before anything heavy is built
+        journal_path = os.path.join(args.out_dir,
+                                    output_name(args.ablation) + ".journal")
         if args.input == "diffs":
             from fira_tpu.ingest.service import serve_diffs
 
@@ -731,17 +809,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                                   clock=args.serve_clock,
                                   metrics_path=metrics_path)
         else:
-            metrics = serve_split(model, params, dataset, cfg,
-                                  arrival_times=times, out_dir=args.out_dir,
-                                  ablation=args.ablation, var_maps=var_maps,
-                                  guard=guard, clock=args.serve_clock,
-                                  metrics_path=metrics_path)
+            from fira_tpu.robust.recovery import ResumeError
+
+            try:
+                metrics = serve_split(model, params, dataset, cfg,
+                                      arrival_times=times,
+                                      out_dir=args.out_dir,
+                                      ablation=args.ablation,
+                                      var_maps=var_maps,
+                                      guard=guard, clock=args.serve_clock,
+                                      metrics_path=metrics_path,
+                                      journal_path=journal_path,
+                                      resume=args.resume)
+            except ResumeError as e:
+                # resume admission (stream count / arrival digest /
+                # request-mix digest — robust.recovery.resume_errors,
+                # the ONE validation site) rejected the journal: the
+                # named exit-2 contract, not a traceback. Any other
+                # mid-run error propagates as the crash it is.
+                print(f"parse-time validation: {e}", file=sys.stderr)
+                return 2
         sv = metrics["serve"]
+        resumed = (f", {sv['resumed']} resumed from journal"
+                   if sv.get("resumed") else "")
         print(f"serve: {sv['completed']}/{sv['offered']} completed "
               f"(shed {sv['shed_queue_full']} queue-full, "
               f"{sv['shed_deadline']} deadline, "
               f"{sv['shed_error']} error; "
-              f"{sv['replica_retirements']} replica retirements)  "
+              f"{sv['replica_retirements']} replica retirements, "
+              f"{sv['respawns']} respawns{resumed})  "
               f"p50/p99 ttft {sv['p50_ttft_s']}/{sv['p99_ttft_s']} s  "
               f"p50/p99 e2e {sv['p50_e2e_s']}/{sv['p99_e2e_s']} s  "
               f"-> {metrics_path}")

@@ -334,6 +334,33 @@ class FiraConfig:
     # retirement. Must be > 0 (validated at parse time, exit 2).
     fault_hang_s: float = 2.0
 
+    # --- self-healing fleet (robust/recovery.py; docs/FAULTS.md
+    # "Recovery contracts") ---
+    # Replacement budget PER REPLICA LINEAGE: how many times a retired
+    # replica slot may be respawned (fresh engine on the dead replica's
+    # device — params re-device_put, paged pool re-allocated, prewarmed
+    # through the declared label family — or a warm spare attached)
+    # before the lineage degrades permanently (the PR-9 retire-and-
+    # requeue behavior). 0 (default) = respawn off: retirement stays
+    # terminal, byte-identical historical behavior. Must be >= 0
+    # (validated at parse time, exit 2 — recovery.recovery_errors).
+    max_respawns: int = 0
+    # Pre-built prewarmed standby engines (the warm-spare pool): a
+    # retirement attaches a spare to the shared admission queue in O(1)
+    # instead of paying a mid-run engine build + prewarm. Spares idle
+    # until attached and count against max_respawns when they attach
+    # (the budget bounds REPLACEMENTS, however they are built). Only
+    # meaningful with max_respawns >= 1 (validated at parse time,
+    # exit 2). Must be >= 0.
+    engine_spares: int = 0
+    # Respawn backoff BASE in wall seconds: a crash-looping lineage waits
+    # the shared robust.faults.backoff_s curve (linear in the attempt,
+    # capped at 5x) rescaled to this base between replacements — and, on
+    # the deterministic virtual clock, min(attempt, 5) scheduler rounds
+    # (wall sleeps only happen on the wall clock, the quarantine-backoff
+    # split). Must be > 0 (validated at parse time, exit 2).
+    respawn_backoff_s: float = 0.25
+
     # --- typed edges (beyond-parity extension) ---
     # The reference computes six edge families then flattens them into one
     # untyped adjacency (process_edge's `kind` is dead, Dataset.py:346-357;

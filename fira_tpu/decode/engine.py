@@ -387,6 +387,17 @@ class SlotEngine:
         return prefills + [self.label(STEP_LABEL), self.label(INSERT_LABEL),
                            self.label(HARVEST_LABEL)]
 
+    def labels_for_tags(self, geom_tags) -> List[str]:
+        """The declared family from already-computed geometry tags (the
+        respawn path holds the stored warm-batch tags, not the bucket
+        table — parallel/fleet.py replace_slot): one prefill label per
+        tag (None = the untagged single-geometry prefill) plus the
+        step/insert/harvest trio."""
+        prefills = [self.label(PREFILL_KIND, t) for t in geom_tags] \
+            or [self.label(PREFILL_KIND)]
+        return prefills + [self.label(STEP_LABEL), self.label(INSERT_LABEL),
+                           self.label(HARVEST_LABEL)]
+
     # --- jitted programs -------------------------------------------------
 
     def _prefill_fn(self, params, batch):

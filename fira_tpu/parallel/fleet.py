@@ -75,6 +75,7 @@ import numpy as np
 from fira_tpu.config import FiraConfig
 from fira_tpu.decode.engine import EngineItem, EngineStats, SlotEngine
 from fira_tpu.model.model import FiraModel
+from fira_tpu.robust import recovery as recovery_lib
 from fira_tpu.robust.watchdog import run_with_watchdog
 
 
@@ -105,6 +106,9 @@ class FleetStats:
     # requeued onto survivors across all retirements
     retirements: List[Dict] = dataclasses.field(default_factory=list)
     requeues: int = 0
+    # recovery accounting (robust/recovery.py): one entry per respawned
+    # replacement ({"replica": new tag, "origin": lineage, "spare": bool})
+    respawns: List[Dict] = dataclasses.field(default_factory=list)
 
     @property
     def commits(self) -> int:
@@ -173,6 +177,12 @@ class FleetStats:
             "retirements": len(self.retirements),
             "retired_replicas": [r["replica"] for r in self.retirements],
             "requeues": self.requeues,
+            # self-healing record (robust/recovery.py): replacements that
+            # joined the fleet mid-run, and whether each was a warm-spare
+            # attach or a fresh mid-run build
+            "respawns": len(self.respawns),
+            "respawned_replicas": [r["replica"] for r in self.respawns],
+            "spare_attaches": sum(1 for r in self.respawns if r["spare"]),
         }
 
 
@@ -223,6 +233,22 @@ class EngineFleet:
         # replica's commits); the run loop keeps its own live list
         self.retirements: List[Dict] = []
         self.requeues: int = 0
+        # recovery machinery (robust/recovery.py): replace_slot needs the
+        # build inputs a respawn re-runs (the ORIGINAL params — each
+        # replacement re-device_puts its own copy), the stored warm
+        # batches, the per-lineage respawn ordinals, and the warm-spare
+        # pool (built on demand by build_spares)
+        self._model = model
+        self._params = params
+        self._guard = guard
+        self._per_replica = per_replica
+        self._per_replica_pool = per_replica_pool
+        self._devices = list(devices)
+        self._warm: Optional[List] = None
+        self._respawn_counts: Dict[str, int] = {}
+        self._spare_seq = 0
+        self.respawns: List[Dict] = []
+        self.spares: List[SlotEngine] = []
         self.engines = [
             SlotEngine(model, jax.device_put(params, devices[i]), cfg,
                        slots=per_replica, guard=guard, device=devices[i],
@@ -235,7 +261,8 @@ class EngineFleet:
     def stats(self) -> FleetStats:
         return FleetStats([e.stats for e in self.engines],
                           retirements=list(self.retirements),
-                          requeues=self.requeues)
+                          requeues=self.requeues,
+                          respawns=list(self.respawns))
 
     def labels(self, table=None) -> List[str]:
         """The fleet's declared program family: the union of every
@@ -245,10 +272,83 @@ class EngineFleet:
     def prewarm(self, warm_batches) -> None:
         """Compile every replica's prefill family up front (each replica
         owns its own executables — per-device compiles are real compiles,
-        and the guard budget prices them per replica label)."""
+        and the guard budget prices them per replica label). The batches
+        are KEPT: a respawned replacement prewarms through the same
+        declared family (replace_slot), so post-warmup dispatches on it
+        never pay a first-use compile either."""
         batches = list(warm_batches)
+        self._warm = batches
         for eng in self.engines:
             eng.prewarm(batches)
+
+    # --- self-healing (robust/recovery.py; docs/FAULTS.md) ---------------
+
+    def _build_replacement(self, device, tag: str) -> SlotEngine:
+        """One fresh engine on ``device``: params re-``device_put``, the
+        per-replica paged pool re-allocated, labels declared under the
+        new tag, and the stored warm batches prewarmed — the replacement
+        pays its compiles HERE (each new label's warmup dispatch), never
+        on a post-warmup serving dispatch."""
+        params = (jax.device_put(self._params, device)
+                  if device is not None else self._params)
+        eng = SlotEngine(self._model, params, self.cfg,
+                         slots=self._per_replica, guard=self._guard,
+                         device=device, tag=tag,
+                         pool_blocks=self._per_replica_pool,
+                         faults=self.faults)
+        if self._guard is not None and self._guard.family_closed:
+            # additive declare into the ALREADY-closed family only: on an
+            # open family (the unbucketed drivers never declare) a first
+            # declare here would close it around just the replacement's
+            # labels and outlaw every serving replica's programs
+            tags = [t for (_h, t) in (self._warm or [])] or [None]
+            self._guard.declare(eng.labels_for_tags(tags))
+        if self._warm:
+            eng.prewarm(self._warm)
+        return eng
+
+    def build_spares(self, count: int) -> None:
+        """Build the warm-spare pool: ``count`` prewarmed standby engines
+        (tags ``sp<i>``, devices round-robin like the fleet), idle until
+        a retirement attaches one. Refills up to ``count`` — a reused
+        warm fleet must not double its pool — and tags from a monotone
+        sequence, never reusing an attached spare's tag (labels and
+        heartbeat/lineage records key on it)."""
+        while len(self.spares) < int(count):  # firacheck: allow[HOST-SYNC] count is the engine_spares config int; no device value exists here
+            i = self._spare_seq
+            self._spare_seq += 1
+            self.spares.append(self._build_replacement(
+                self._devices[i % len(self._devices)], f"sp{i}"))
+
+    def take_spare(self, device) -> Optional[SlotEngine]:
+        """Pop a spare, preferring one already on ``device`` (zero
+        cross-device params movement); any spare otherwise — restored
+        capacity beats placement."""
+        for i, sp in enumerate(self.spares):
+            if sp.device is device:
+                return self.spares.pop(i)
+        return self.spares.pop(0) if self.spares else None
+
+    def replace_slot(self, origin: str, device):
+        """Replace one retired lineage: a warm spare when the pool has
+        one (O(attach)), else a fresh build on the lineage's device
+        (O(compile)). The replacement joins the ROSTER here (its commits
+        count in FleetStats); the caller owns adding it to the live
+        service rotation. Returns (engine, from_spare)."""
+        spare = self.take_spare(device)
+        if spare is not None:
+            self.engines.append(spare)
+            self.respawns.append({"replica": spare.tag or "r0",
+                                  "origin": origin, "spare": True})
+            return spare, True
+        k = self._respawn_counts.get(origin, 0) + 1
+        self._respawn_counts[origin] = k
+        tag = f"{origin}{recovery_lib.RESPAWN_TAG_SEP}{k}"
+        eng = self._build_replacement(device, tag)
+        self.engines.append(eng)
+        self.respawns.append({"replica": tag, "origin": origin,
+                              "spare": False})
+        return eng, False
 
     @staticmethod
     def _as_payload(item) -> Dict:
@@ -265,12 +365,17 @@ class EngineFleet:
         return host
 
     def _retire(self, eng: SlotEngine, alive: List[SlotEngine],
-                pending: "collections.deque", err: BaseException) -> None:
+                pending: "collections.deque", err: BaseException,
+                recovery=None) -> None:
         """Retire one replica: drop it from the service rotation, requeue
         every request it still owed at the FRONT of the shared admission
-        stream (they arrived earliest), and record the event. With no
-        survivors there is nothing to degrade onto — a drain run must
-        fail loudly, never hang."""
+        stream (they arrived earliest), and record the event. With
+        ``recovery`` armed (cfg.max_respawns — robust/recovery.py) dead
+        lineages with budget left are respawned HERE, immediately and
+        wall-backed-off (drain mode has no scheduler rounds to gate on),
+        and the replacements join the live rotation. With no survivors
+        and no respawn budget there is nothing to degrade onto — a drain
+        run must fail loudly, never hang."""
         alive.remove(eng)
         payloads = eng.retire()
         # TOCTOU guard: an admit the watchdog abandoned can finish
@@ -304,6 +409,12 @@ class EngineFleet:
         self.requeues += n_req
         self.retirements.append({"replica": eng.tag or "r0",
                                  "error": f"{type(err).__name__}: {err}"})
+        if recovery is not None:
+            recovery.note_retirement(eng, -1,
+                                     error=f"{type(err).__name__}: {err}")
+            for new in recovery.heal_all():
+                new.begin_stream()
+                alive.append(new)
         if not alive:
             raise RuntimeError(
                 f"all {len(self.engines)} fleet replicas retired; last "
@@ -330,6 +441,16 @@ class EngineFleet:
         feed_iter = iter(feed)
         exhausted = False
         wd = float(self.cfg.dispatch_watchdog_s)
+        # self-healing (robust/recovery.py): with a respawn budget armed,
+        # a retirement is followed by an immediate wall-backed-off
+        # replacement instead of staying a permanent capacity loss
+        recovery = (recovery_lib.RecoveryManager(self, self.cfg,
+                                                 wall_clock=True)
+                    if self.cfg.max_respawns > 0 else None)
+        if recovery is not None and self.cfg.engine_spares:
+            # the drain path arms its own spare pool (the serve driver
+            # builds it in serve_split) — a knob that validates must act
+            self.build_spares(self.cfg.engine_spares)
         # re-admission payloads from retired replicas, served head-first
         pending: "collections.deque" = collections.deque()
         alive = [eng for eng in self.engines if not eng.retired]
@@ -366,7 +487,7 @@ class EngineFleet:
                     run_with_watchdog(lambda: eng.refill(refill_order), wd,
                                       label=f"refill[{eng.tag}]")
                 except Exception as e:
-                    self._retire(eng, alive, pending, e)
+                    self._retire(eng, alive, pending, e, recovery)
             live = [eng for eng in alive if eng.in_flight()]
             if not live:
                 if exhausted and not pending:
@@ -380,7 +501,7 @@ class EngineFleet:
                     run_with_watchdog(eng.step_dispatch, wd,
                                       label=f"step[{eng.tag}]")
                 except Exception as e:
-                    self._retire(eng, alive, pending, e)
+                    self._retire(eng, alive, pending, e, recovery)
             for eng in live:
                 if eng.retired:
                     continue
@@ -388,6 +509,6 @@ class EngineFleet:
                     items = run_with_watchdog(eng.harvest, wd,
                                               label=f"harvest[{eng.tag}]")
                 except Exception as e:
-                    self._retire(eng, alive, pending, e)
+                    self._retire(eng, alive, pending, e, recovery)
                     continue
                 yield from items

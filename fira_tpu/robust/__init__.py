@@ -14,11 +14,19 @@ registry that proves it deterministically in tier-1:
 - :mod:`fira_tpu.robust.watchdog` — a per-dispatch wall-clock watchdog
   (run the dispatch in a worker thread, abandon it on expiry) backing
   replica retirement in the fleet/serve loops and the dev-gate skip in
-  train/loop.py.
+  train/loop.py;
+- :mod:`fira_tpu.robust.recovery` — the self-healing half (docs/FAULTS
+  .md "Recovery contracts"): replica respawn with warm spares and
+  per-lineage budget/backoff, plus the write-ahead request journal and
+  crash-resume machinery behind ``cli serve --resume``.
 """
 
 from fira_tpu.robust.faults import (FaultSpec, FaultInjector,  # noqa: F401
                                     InjectedFault, injector_from,
                                     parse_fault_specs, robust_errors)
+from fira_tpu.robust.recovery import (Journal, RecoveryManager,  # noqa: F401
+                                      read_journal, recover_output,
+                                      recovery_errors, respawn_backoff_s,
+                                      resume_errors)
 from fira_tpu.robust.watchdog import (WatchdogTimeout,  # noqa: F401
                                       run_with_watchdog)

@@ -1,0 +1,443 @@
+"""Self-healing fleet: replica respawn, warm spares, crash-resume
+(docs/FAULTS.md "Recovery contracts").
+
+PR 9's degradation machinery stops at *retirement*: a faulted replica
+leaves the rotation and survivors absorb its requests, but the capacity
+is gone for good and losing every replica sheds the remaining stream.
+This module closes the loop from failure back to full capacity, with
+output bytes a pure function of the request stream under ANY
+failure/recovery trace:
+
+- **Replica respawn** — :class:`RecoveryManager` tracks one
+  :class:`ReplicaSlot` per replica LINEAGE (``r1`` and every engine that
+  ever replaced it share one respawn budget), gates each respawn on the
+  shared backoff curve (:func:`respawn_backoff_s` — the
+  ``robust.faults.backoff_s`` shape rescaled to the
+  ``cfg.respawn_backoff_s`` base), and delegates construction to
+  ``EngineFleet.replace_slot`` (fresh ``SlotEngine`` on the dead
+  replica's device, params re-``device_put``, paged pool re-allocated,
+  prewarmed through the declared label family) or to the warm-spare
+  pool (``cfg.engine_spares`` pre-built prewarmed standby engines —
+  replacement becomes O(attach) instead of O(compile)). A crash-looping
+  lineage exhausts ``cfg.max_respawns`` and degrades permanently
+  instead of flapping.
+
+- **Crash-resume** — :class:`Journal`, an append-only write-ahead
+  request journal next to the output file (one fsync'd JSONL record per
+  request at admit and at done/shed, riding the atomic-metrics idiom).
+  After a SIGKILL, :func:`recover_output` reads the
+  ``OrderedStreamWriter`` crash pair (the plain ``.partial`` prefix plus
+  the position-tagged ``.partial.tail``, torn trailing lines dropped)
+  and ``cli serve --resume`` re-serves exactly the positions with no
+  terminal line on disk: every position is emitted exactly once, and on
+  a run whose requests all complete the final file is byte-identical to
+  an uninterrupted run — machine-checked (tests/test_recovery.py,
+  scripts/chaos_bench.py --recovery-smoke). A terminal outcome that
+  REACHED disk — a finished prediction or a recorded shed's empty
+  line — is final across a resume (re-adjudicating sheds could not be
+  byte-stable either: shed decisions depend on load timing the resumed
+  run does not reproduce).
+
+Determinism: which bytes land at which position never depends on the
+failure/recovery trace (per-row beam independence + the position-keyed
+writer — the PR 9 contract); recovery only changes WHEN capacity comes
+back, and that schedule is itself deterministic on the virtual clock
+(backoff is measured in scheduler rounds there; on the wall clock it is
+GATED in wall seconds — never slept on the serve scheduler thread, so
+surviving replicas keep being stepped through a lineage's backoff).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fira_tpu.config import FiraConfig
+from fira_tpu.robust import faults as faults_lib
+
+# the shared curve caps at 5x its base (faults.backoff_s: linear in the
+# attempt, capped) — the same cap bounds the round-gated backoff below
+_BACKOFF_CAP_ATTEMPTS = 5
+# respawn tags: lineage origin + "~" + respawn ordinal ("r1" dies ->
+# "r1~1" -> "r1~2"); "~" never appears in fleet ("r<i>") or spare
+# ("sp<i>") tags, so origin recovery is one split
+RESPAWN_TAG_SEP = "~"
+
+
+# --------------------------------------------------------------------------
+# parse-time knob validation (CLI exit 2 — the recovery twin of
+# robust.faults.robust_errors / serve.server.serve_errors)
+# --------------------------------------------------------------------------
+
+def recovery_errors(cfg: FiraConfig) -> List[str]:
+    """Named-knob recovery admission check: spare count, respawn budget,
+    backoff base — one message per violation, CLI exit 2."""
+    errs: List[str] = []
+    if cfg.engine_spares < 0:
+        errs.append(
+            f"engine_spares {cfg.engine_spares} must be >= 0 pre-built "
+            f"prewarmed standby engines")
+    if cfg.max_respawns < 0:
+        errs.append(
+            f"max_respawns {cfg.max_respawns} must be >= 0 (0 = replica "
+            f"respawn off — the PR-9 retire-and-degrade behavior)")
+    if cfg.respawn_backoff_s <= 0:
+        errs.append(
+            f"respawn_backoff_s {cfg.respawn_backoff_s} must be > 0 wall "
+            f"seconds (the per-lineage respawn backoff base; the shared "
+            f"robust.faults.backoff_s curve scales from it)")
+    if cfg.engine_spares > 0 and cfg.max_respawns == 0:
+        errs.append(
+            f"engine_spares {cfg.engine_spares} builds a standby pool "
+            f"nothing can attach: max_respawns is 0 (respawn disabled); "
+            f"set max_respawns >= 1 to let spares replace dead replicas")
+    return errs
+
+
+def respawn_backoff_s(attempt: int, base: float) -> float:
+    """Per-lineage respawn backoff, wall seconds: the shared quarantine
+    curve (robust.faults.backoff_s — linear in the attempt, capped at
+    5x) rescaled from its 0.01 s base to ``cfg.respawn_backoff_s``. One
+    curve definition repo-wide, so the backoff POLICY cannot silently
+    fork between the retry sites and the respawn site."""
+    return faults_lib.backoff_s(attempt) * (float(base) / 0.01)  # firacheck: allow[HOST-SYNC] base is the respawn_backoff_s config float; no device value exists here
+
+
+def origin_of(tag: Optional[str]) -> str:
+    """A replica tag's lineage origin: ``r1~2`` -> ``r1`` (every respawn
+    of a slot shares the original replica's budget)."""
+    return (tag or "r0").split(RESPAWN_TAG_SEP)[0]
+
+
+# --------------------------------------------------------------------------
+# write-ahead request journal (crash-resume)
+# --------------------------------------------------------------------------
+
+def times_digest(times) -> str:
+    """Content digest of an arrival schedule (nanosecond-rounded), the
+    resume admission check: a journal written for a different request
+    stream must be rejected, not silently half-replayed."""
+    t = np.asarray(times, dtype=np.float64)
+    msg = ",".join(f"{x:.9f}" for x in t).encode()
+    return hashlib.blake2b(msg, digest_size=8).hexdigest()
+
+
+class Journal:
+    """Append-only JSONL write-ahead request journal.
+
+    One fsync'd record per request at admit and at done/shed (the
+    OrderedStreamWriter/atomic-metrics crash discipline applied to
+    request lifecycle): a SIGKILL at any instant leaves a parseable
+    prefix whose torn trailing line :func:`read_journal` drops. The
+    ``begin`` record pins the stream identity (request count + arrival
+    digest + request-mix digest) so ``--resume`` can refuse a journal
+    from a different run.
+    """
+
+    def __init__(self, path: str, *, n: int, times, mix=None,
+                 resume: bool = False):
+        self.path = path
+        # resume APPENDS a new generation (the prior records are the
+        # recovery source); a fresh run truncates
+        self._f = open(path, "a" if resume else "w")
+        self.append({"kind": "begin", "n": int(n),
+                     "times_digest": times_digest(times),
+                     "mix_digest": (times_digest(mix) if mix is not None
+                                    else None),
+                     "resume": bool(resume)})
+
+    def append(self, rec: Dict) -> None:
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def append_many(self, recs: List[Dict]) -> None:
+        """One write + one fsync for a batch of records (the per-round
+        admit/done batches — still one RECORD per request)."""
+        if not recs:
+            return
+        self._f.write("".join(json.dumps(r) + "\n" for r in recs))
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def admit(self, positions: List[int]) -> None:
+        self.append_many([{"kind": "admit", "pos": int(p)}
+                          for p in positions])
+
+    def done(self, positions: List[int]) -> None:
+        self.append_many([{"kind": "done", "pos": int(p)}
+                          for p in positions])
+
+    def shed(self, pos: int, status: str, error: Optional[str]) -> None:
+        self.append({"kind": "shed", "pos": int(pos), "status": status,
+                     "error": error})
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> "Journal":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+def read_journal(path: str) -> Tuple[Optional[Dict], Dict[int, Dict]]:
+    """Parse a journal: (first begin record, terminal record per
+    position). A torn trailing line (no newline, or a partial JSON
+    document — the SIGKILL case) is DROPPED, never an error; a done and
+    a shed for the same position keep the latest (a resumed run may
+    complete a request the killed run had shed un-persisted)."""
+    meta: Optional[Dict] = None
+    terminal: Dict[int, Dict] = {}
+    if not os.path.exists(path):
+        return None, {}
+    with open(path, "rb") as f:
+        raw = f.read()
+    lines = raw.split(b"\n")
+    if lines and lines[-1] != b"":
+        lines = lines[:-1]   # torn tail: the kill landed mid-write
+    for line in lines:
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except (ValueError, UnicodeDecodeError):
+            continue   # a torn interior line can only be the last one
+            #            fsync'd mid-kill; skipping it is the truncation
+        kind = rec.get("kind")
+        if kind == "begin" and meta is None:
+            meta = rec
+        elif kind in ("done", "shed") and "pos" in rec:
+            terminal[int(rec["pos"])] = rec  # firacheck: allow[HOST-SYNC] rec is a parsed JSON journal record (host dict); no device value exists here
+    return meta, terminal
+
+
+class ResumeError(ValueError):
+    """A ``--resume`` admission failure (missing/mismatched journal):
+    the CLI converts exactly this — never an arbitrary mid-run
+    ValueError — into its named exit-2 contract."""
+
+
+def missing_journal_error(path: str) -> str:
+    """The one definition of the no-prior-run message (the CLI's early
+    pre-dataset check and :func:`resume_errors` both print it — one
+    string, no drift)."""
+    return (f"--resume requires an existing serve journal at {path} "
+            f"(no prior `cli serve` run to resume)")
+
+
+def resume_errors(path: str, n: int, times, mix=None) -> List[str]:
+    """Admission check for ``--resume``: the journal must exist, parse,
+    and pin the SAME request stream (count + arrival digest +
+    request-mix digest). Named messages, CLI exit 2."""
+    if not os.path.exists(path):
+        return [missing_journal_error(path)]
+    meta, _ = read_journal(path)
+    if meta is None:
+        return [f"--resume: journal {path} holds no begin record (the "
+                f"prior run died before its first fsync — rerun without "
+                f"--resume)"]
+    errs: List[str] = []
+    if int(meta.get("n", -1)) != int(n):
+        errs.append(
+            f"--resume: journal {path} was written for {meta.get('n')} "
+            f"requests but this run offers {n} (a different request "
+            f"stream cannot be resumed)")
+    elif meta.get("times_digest") != times_digest(times):
+        errs.append(
+            f"--resume: journal {path} was written for a different "
+            f"arrival schedule (digest mismatch — same trace/seed/rate "
+            f"required)")
+    elif meta.get("mix_digest") != (times_digest(mix)
+                                    if mix is not None else None):
+        errs.append(
+            f"--resume: journal {path} was written for a different "
+            f"request->sample mix (mix digest mismatch — recovered lines "
+            f"and the re-served suffix would mix two request identities)")
+    return errs
+
+
+def _complete_lines(path: str) -> List[str]:
+    """Every COMPLETE (newline-terminated) line of ``path``, bytes split
+    on b"\\n" only — never str.splitlines, whose extra boundaries
+    (\\x0b, \\u2028, ...) would shift positions inside a prediction line
+    and silently break resume byte-identity. A torn trailing fragment
+    (the SIGKILL case) is dropped."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    pieces = raw.split(b"\n")[:-1]   # the post-final-\n fragment (torn
+    #                                  or empty) carries no complete line
+    return [(p + b"\n").decode("utf-8") for p in pieces]
+
+
+def recover_output(out_path: str, expected: int) -> Dict[int, str]:
+    """Recover every finished line of an interrupted (or completed) run:
+    the contiguous ``.partial`` prefix plus the position-tagged
+    ``.partial.tail`` spill (the OrderedStreamWriter crash pair), torn
+    trailing lines dropped; a completed run recovers from the final file
+    itself. Returns {position: line-with-newline} — the exactly-once
+    seed the resume writer re-emits verbatim."""
+    recovered: Dict[int, str] = {}
+    partial = out_path + ".partial"
+    tail = out_path + ".partial.tail"
+    if os.path.exists(out_path) and not os.path.exists(partial):
+        for pos, line in enumerate(_complete_lines(out_path)):
+            if pos < expected:
+                recovered[pos] = line
+        return recovered
+    if os.path.exists(partial):
+        for pos, line in enumerate(_complete_lines(partial)):
+            if pos < expected:
+                recovered[pos] = line
+    if os.path.exists(tail):
+        for raw in _complete_lines(tail):
+            if "\t" not in raw:
+                continue   # malformed tail record
+            pos_s, line = raw.split("\t", 1)
+            try:
+                pos = int(pos_s)  # firacheck: allow[HOST-SYNC] pos_s is a position tag parsed from the writer's on-disk tail spill; no device value exists here
+            except ValueError:
+                continue
+            if 0 <= pos < expected:
+                recovered[pos] = line
+    return recovered
+
+
+# --------------------------------------------------------------------------
+# respawn policy
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ReplicaSlot:
+    """One replica lineage's health record: the original replica and
+    every engine that ever replaced it share this budget/backoff state."""
+
+    origin: str
+    device: Any = None
+    respawns: int = 0            # replacement attempts consumed (spares
+    #                              count — the budget bounds REPLACEMENTS)
+    alive: bool = True
+    retired_round: int = -1      # scheduler round of the latest retirement
+    retired_wall: float = -1.0   # monotonic stamp of it (wall-clock gate)
+    last_error: str = ""
+
+
+class RecoveryManager:
+    """Health-driven respawn policy over one engine fleet.
+
+    Decisions only — construction is ``fleet.replace_slot`` (which owns
+    the spare pool, the device placement, and the prewarm-through-the-
+    declared-family contract). Backoff is gated in scheduler ROUNDS
+    (``retired_round + min(attempt, 5)`` — deterministic on the virtual
+    clock) and additionally GATED (never slept — the scheduler thread
+    keeps stepping the survivors) in wall seconds on the wall clock via
+    the shared curve (:func:`respawn_backoff_s`)."""
+
+    def __init__(self, fleet, cfg: FiraConfig, *, wall_clock: bool = False):
+        self.fleet = fleet
+        self.max_respawns = int(cfg.max_respawns)
+        self.backoff_base = float(cfg.respawn_backoff_s)
+        self.wall_clock = bool(wall_clock)
+        self.slots: Dict[str, ReplicaSlot] = {}
+        # spares attached to a lineage keep their own (pre-compiled) tag;
+        # this map folds their future deaths back onto the lineage budget
+        self._lineage: Dict[str, str] = {}
+        for eng in fleet.engines:
+            o = origin_of(eng.tag)
+            self.slots[o] = ReplicaSlot(origin=o, device=eng.device)
+
+    def _slot_of(self, eng) -> ReplicaSlot:
+        o = self._lineage.get(eng.tag or "r0", origin_of(eng.tag))
+        if o not in self.slots:
+            self.slots[o] = ReplicaSlot(origin=o, device=eng.device)
+        return self.slots[o]
+
+    def note_retirement(self, eng, round_: int, error: str = "") -> None:
+        """Record one retirement against the engine's lineage (the
+        respawn clock starts here)."""
+        s = self._slot_of(eng)
+        s.alive = False
+        s.retired_round = int(round_)
+        s.retired_wall = time.monotonic()
+        s.last_error = error
+
+    def can_recover(self) -> bool:
+        """True while any dead lineage still has respawn budget — the
+        all-replicas-lost branch pauses admission on this instead of
+        shedding the remainder."""
+        return any(not s.alive and s.respawns < self.max_respawns
+                   for s in self.slots.values())
+
+    def due(self, round_: int) -> List[ReplicaSlot]:
+        """Dead lineages whose backoff has elapsed and whose budget is
+        not exhausted, origin order (deterministic). Round-gated always
+        (``min(attempt, 5)`` rounds); on the wall clock ALSO gated by
+        the shared curve in wall seconds — gated, never slept, so the
+        surviving replicas keep being stepped through a lineage's
+        backoff window."""
+        out = []
+        for o in sorted(self.slots):
+            s = self.slots[o]
+            if s.alive or s.respawns >= self.max_respawns:
+                continue
+            if self.wall_clock:
+                # wall clock: the gate is wall seconds alone — rounds
+                # are step dispatches and FREEZE during a total outage
+                # (the serve pause branch), so a round gate could never
+                # elapse there
+                if (s.retired_wall >= 0
+                        and time.monotonic() - s.retired_wall
+                        < respawn_backoff_s(s.respawns + 1,
+                                            self.backoff_base)):
+                    continue
+            else:
+                wait = min(s.respawns + 1, _BACKOFF_CAP_ATTEMPTS)
+                if round_ - s.retired_round < wait:
+                    continue
+            out.append(s)
+        return out
+
+    def respawn(self, slot: ReplicaSlot, round_: int):
+        """One replacement attempt for ``slot``: spare attach when the
+        pool has one, else a fresh build on the lineage's device. Every
+        attempt — success, spare, or builder failure — consumes budget
+        (a builder that keeps failing must exhaust, not spin). Returns
+        (engine, from_spare) or (None, False) on failure."""
+        slot.respawns += 1
+        try:
+            eng, from_spare = self.fleet.replace_slot(slot.origin,
+                                                      slot.device)
+        except Exception as e:
+            slot.retired_round = int(round_)   # backoff restarts
+            slot.retired_wall = time.monotonic()
+            slot.last_error = f"respawn failed: {type(e).__name__}: {e}"
+            return None, False
+        slot.alive = True
+        if from_spare:
+            self._lineage[eng.tag or "r0"] = slot.origin
+        return eng, from_spare
+
+    def heal_all(self) -> List:
+        """Drain-mode healing (no scheduler rounds): respawn every dead
+        lineage with budget left, immediately, wall-backed-off — the
+        sleep is fine HERE because the drain driver is single-threaded
+        batch work with no open-loop arrivals to starve. Returns the new
+        engines (the fleet run loop appends them to its live list)."""
+        new = []
+        for o in sorted(self.slots):
+            s = self.slots[o]
+            while not s.alive and s.respawns < self.max_respawns:
+                time.sleep(respawn_backoff_s(s.respawns + 1,
+                                             self.backoff_base))
+                eng, _sp = self.respawn(s, s.retired_round)
+                if eng is not None:
+                    new.append(eng)
+        return new

@@ -262,6 +262,24 @@ class ServeStats:
     shed_error: int = 0
     retirements: List[Dict] = dataclasses.field(default_factory=list)
     requeues: int = 0
+    # self-healing + health signals (robust/recovery.py; docs/FAULTS.md
+    # "Recovery contracts") — recorded UNCONDITIONALLY, recovery armed or
+    # not, like feed-stall: the ROADMAP item-3 scale-up/down control
+    # signal. ``replicas_alive_over_time`` appends one entry per change
+    # in the live-replica set ({"round", "alive", "queue_depth",
+    # "deadline_pressure"}); ``heartbeats`` stamps each replica's
+    # last-dispatch round and dispatch count per scheduler round;
+    # ``respawns`` records each replacement that rejoined the rotation;
+    # ``admission_paused_rounds`` counts all-replicas-lost rounds spent
+    # waiting on a respawn instead of shedding the remainder; ``resumed``
+    # counts positions restored from a prior run's journal + output
+    # prefix by ``--resume`` (never re-served, never re-emitted twice)
+    replicas_alive_over_time: List[Dict] = dataclasses.field(
+        default_factory=list)
+    respawns: List[Dict] = dataclasses.field(default_factory=list)
+    heartbeats: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+    admission_paused_rounds: int = 0
+    resumed: int = 0
     # in-flight dedup accounting (cfg.prefix_cache): requests coalesced
     # onto a byte-identical leader's seat, how many fan-out groups
     # delivered, and the largest group (leader + followers)
@@ -295,6 +313,13 @@ class ServeStats:
             "replica_retirements": len(self.retirements),
             "retired_replicas": [r["replica"] for r in self.retirements],
             "requeued_requests": self.requeues,
+            "respawns": len(self.respawns),
+            "respawned_replicas": [r["replica"] for r in self.respawns],
+            "spare_attaches": sum(1 for r in self.respawns if r["spare"]),
+            "replicas_alive_over_time": list(self.replicas_alive_over_time),
+            "heartbeats": {t: dict(h) for t, h in self.heartbeats.items()},
+            "admission_paused_rounds": self.admission_paused_rounds,
+            "resumed": self.resumed,
             "request_retries": sum(r.retries for r in self.records),
             "deadline_missed": sum(r.deadline_missed for r in done),
             "dedup_coalesced": self.dedup_coalesced,
@@ -372,7 +397,8 @@ class ServeLoop:
     def __init__(self, engines: Sequence[SlotEngine], cfg: FiraConfig, *,
                  arrival_times: np.ndarray, feed, table, assignment,
                  templates: Dict[int, Dict], clock, emit, shed,
-                 refill_order: str = "fifo", faults=None, snapshot=None):
+                 refill_order: str = "fifo", faults=None, snapshot=None,
+                 positions=None, journal=None, recovery=None):
         self.engines = list(engines)
         self.cfg = cfg
         self.clock = clock
@@ -415,9 +441,27 @@ class ServeLoop:
         self._payloads: Dict[int, _Queued] = {}
         self._awaiting_first_step: List[RequestRecord] = []
         self._final = 0
+        # output position per arrival-stream request: identity normally;
+        # a ``--resume`` run serves the not-yet-done SUFFIX of a prior
+        # run's positions (robust/recovery.py), so positions are sparse
+        # original indices and every position-keyed lookup goes through
+        # ``_rec_by_pos`` instead of indexing the records list
+        pos_arr = (np.asarray(positions, dtype=np.int64)
+                   if positions is not None
+                   else np.arange(len(self._times), dtype=np.int64))
         self.stats = ServeStats(records=[
-            RequestRecord(position=i, arrival_t=float(t))
-            for i, t in enumerate(self._times)])
+            RequestRecord(position=int(p), arrival_t=float(t))
+            for p, t in zip(pos_arr, self._times)])
+        self._rec_by_pos: Dict[int, RequestRecord] = {
+            r.position: r for r in self.stats.records}
+        # self-healing + health machinery (docs/FAULTS.md "Recovery
+        # contracts"): the write-ahead request journal (None = off), the
+        # respawn policy (None = PR-9 retire-and-degrade), and the
+        # always-on alive/heartbeat record (satellite of ROADMAP item 3)
+        self._journal = journal
+        self._recovery = recovery
+        self._shed_log: List[Dict] = []   # round-buffered shed WAL records
+        self._alive_changed()
 
     # --- pieces ---------------------------------------------------------
 
@@ -475,7 +519,7 @@ class ServeLoop:
                         >= self._cap:
                     self._shed(rec, "shed_queue_full")
                 else:
-                    lrec = self.stats.records[leader]
+                    lrec = self._rec_by_pos[leader]
                     e = _Queued(rec, item.host, self._bucket_of(i, item),
                                 digest=digest)
                     self._followers.setdefault(leader, []).append(e)
@@ -577,6 +621,14 @@ class ServeLoop:
                     self._followers[head.record.position] = rest
                 self._promoted.append(head)
         self.shed_cb(rec)
+        # terminal WAL record AFTER the writer took the empty line (so
+        # the record never claims a position whose line missed the
+        # disk); buffered and flushed once per scheduler round like the
+        # admit/done batches — one fsync per round, not per shed, which
+        # matters exactly on the mass-shed collapse path
+        if self._journal is not None:
+            self._shed_log.append({"kind": "shed", "pos": rec.position,
+                                   "status": status, "error": rec.error})
 
     def _drain_promotions(self) -> None:
         """Enqueue followers promoted to leader by a leader shed. Runs
@@ -613,7 +665,7 @@ class ServeLoop:
         self._queue = keep
         self._drain_promotions()
         for leader, fl in list(self._followers.items()):
-            lrec = self.stats.records[leader]
+            lrec = self._rec_by_pos[leader]
             if lrec.status not in ("queued", "staged"):
                 continue
             for e in list(fl):
@@ -752,6 +804,17 @@ class ServeLoop:
         self.stats.retirements.append(
             {"replica": eng.tag or "r0",
              "error": f"{type(err).__name__}: {err}"})
+        # health record + respawn clock (robust/recovery.py): the
+        # heartbeat goes cold, the alive trace steps down, and — with
+        # recovery armed — the lineage's round-gated backoff starts
+        hb = self.stats.heartbeats.get(eng.tag or "r0")
+        if hb is not None:
+            hb["alive"] = False
+        if self._recovery is not None:
+            self._recovery.note_retirement(
+                eng, self.stats.rounds,
+                error=f"{type(err).__name__}: {err}")
+        self._alive_changed()
         entries: List[_Queued] = []
         seen: set = set()
         for pos in owed:
@@ -822,6 +885,7 @@ class ServeLoop:
         so rotation is purely a load-balance choice, and a
         deterministic one)."""
         admitted = 0
+        admitted_pos: List[int] = []
         order = (self.engines[self._rr:] + self.engines[:self._rr])
         self._rr = (self._rr + 1) % len(self.engines) if self.engines else 0
         for eng in order:
@@ -869,9 +933,11 @@ class ServeLoop:
                     for e in group:
                         e.record.admit_t = t
                         e.record.status = "staged"
+                        admitted_pos.append(e.record.position)
                         for f in self._followers.get(e.record.position, []):
                             f.record.admit_t = t
                             f.record.status = "staged"
+                            admitted_pos.append(f.record.position)
                 if retired:
                     break
             admitted += n
@@ -883,13 +949,23 @@ class ServeLoop:
                                   label=f"serve_refill[{eng.tag or 'r0'}]")
             except Exception as e:
                 self._retire_replica(eng, e)
+        if self._journal is not None and admitted_pos:
+            # admit WAL records: one per request, one fsync per round.
+            # Resume correctness rides on the BEGIN record (stream
+            # identity) + the writer crash pair; these per-request
+            # records are the crash-surviving outcome/post-mortem log —
+            # "never admitted" vs "admitted but unfinished" for capacity
+            # analysis, shed statuses+errors that would otherwise exist
+            # only in the metrics snapshot, and the progress probe the
+            # kill legs poll (scripts/chaos_bench.py)
+            self._journal.admit(admitted_pos)
         self.stats.admits += admitted
         self.stats.max_admits_per_round = max(
             self.stats.max_admits_per_round, admitted)
         t = self.clock.now()
         for eng in self.engines:
             for pid in eng.in_flight_positions():
-                rec = self.stats.records[pid]
+                rec = self._rec_by_pos[pid]
                 if math.isnan(rec.seat_t):
                     rec.seat_t = t
                     rec.status = "seated"
@@ -902,6 +978,75 @@ class ServeLoop:
                             f.record.seat_t = t
                             f.record.status = "seated"
                             self._awaiting_first_step.append(f.record)
+
+    # --- health signals + self-healing (robust/recovery.py) -------------
+
+    def _deadline_pressure(self) -> float:
+        """Fraction of queued requests past HALF their deadline — the
+        scale-up urgency gauge the alive trace records (0.0 with no
+        deadline armed or an empty queue)."""
+        if not self._deadline or not self._queue:
+            return 0.0
+        tight = sum(1 for e in self._queue
+                    if self.stats.rounds - e.record.arrival_round
+                    >= self._deadline / 2)
+        return round(tight / len(self._queue), 4)
+
+    def _alive_changed(self) -> None:
+        """Append one alive-trace entry (the ROADMAP item-3 control
+        signal): called at start, on every retirement, and on every
+        respawn — the entries ARE the capacity-restored-over-time curve
+        the recovery bench reads."""
+        self.stats.replicas_alive_over_time.append({
+            "round": self.stats.rounds,
+            "alive": len(self.engines),
+            "queue_depth": len(self._queue),
+            "deadline_pressure": self._deadline_pressure(),
+        })
+
+    def _stamp_heartbeats(self) -> None:
+        """Per-replica per-round heartbeat: last-dispatch round + total
+        dispatches (a retired replica's stamp goes cold and its
+        last-dispatch AGE grows — the health signal respawn decisions
+        and post-mortems read). Recorded unconditionally, recovery armed
+        or not."""
+        for eng in self.engines:
+            hb = self.stats.heartbeats.setdefault(
+                eng.tag or "r0",
+                {"last_dispatch_round": -1, "rounds": 0, "alive": True})
+            hb["last_dispatch_round"] = self.stats.rounds
+            hb["rounds"] += 1
+            hb["alive"] = True
+
+    def _flush_shed_log(self) -> None:
+        """Flush the round's buffered shed WAL records (one fsync for
+        the whole batch — see _shed)."""
+        if self._journal is not None and self._shed_log:
+            self._journal.append_many(self._shed_log)
+            self._shed_log = []
+
+    def _heal(self) -> None:
+        """Respawn every dead lineage whose backoff elapsed and whose
+        budget is not exhausted: the replacement (warm spare or fresh
+        build — EngineFleet.replace_slot) attaches to the shared
+        admission queue and starts pulling next round. Machine-recorded
+        in ServeStats.respawns + the alive trace."""
+        if self._recovery is None:
+            return
+        for slot in self._recovery.due(self.stats.rounds):
+            attempt = slot.respawns + 1
+            eng, from_spare = self._recovery.respawn(slot,
+                                                     self.stats.rounds)
+            if eng is None:
+                continue   # builder failed: budget consumed, backoff
+                #            restarted — retried or exhausted next rounds
+            eng.begin_stream()
+            self.engines.append(eng)
+            self.stats.respawns.append({
+                "replica": eng.tag or "r0", "origin": slot.origin,
+                "round": self.stats.rounds, "attempt": attempt,
+                "spare": from_spare})
+            self._alive_changed()
 
     # --- the loop -------------------------------------------------------
 
@@ -917,13 +1062,45 @@ class ServeLoop:
             self._snapshot(self)   # a valid partial artifact exists from
             #                        the very first moment (kill contract)
         while self._final < n:
+            self._heal()
             if not self.engines:
-                # every replica retired: shed the remainder with the
-                # reason recorded — position-complete output, no hang
+                if (self._recovery is not None
+                        and self._recovery.can_recover()):
+                    # all replicas lost but respawn budget remains: PAUSE
+                    # admission (nothing dispatches) while arrivals keep
+                    # queuing and deadline clocks keep ticking at their
+                    # TRUE rounds — the recorded queue-depth/deadline-
+                    # pressure signal stays honest through the outage —
+                    # and let the round clock tick so the respawn backoff
+                    # elapses: a recoverable outage, not a shed-the-
+                    # remainder collapse. The budget is finite, so this
+                    # loop always terminates: either a replacement
+                    # attaches or can_recover goes False.
+                    self._poll_arrivals(self.clock.now())
+                    self._shed_deadlines()
+                    self._flush_shed_log()
+                    self.stats.admission_paused_rounds += 1
+                    if isinstance(self.clock, WallClock):
+                        # wall outage: the respawn gate is wall-time
+                        # (RecoveryManager.due) and rounds are STEP
+                        # DISPATCHES — nothing dispatches, so the
+                        # deadline clock must not inflate with spin
+                        # iterations; just wait a beat
+                        time.sleep(0.01)
+                    else:
+                        # virtual replay: the round clock IS the backoff
+                        # gate — tick it deterministically
+                        self.clock.on_step()
+                        self.stats.rounds += 1
+                    continue
+                # every replica retired and no respawn budget left: shed
+                # the remainder with the reason recorded —
+                # position-complete output, no hang
                 last = (self.stats.retirements[-1]["error"]
                         if self.stats.retirements else "unknown")
                 self._shed_all_remaining(
                     f"no live replicas (all retired; last error: {last})")
+                self._flush_shed_log()
                 break
             self._poll_arrivals(self.clock.now())
             self._shed_deadlines()
@@ -962,6 +1139,7 @@ class ServeLoop:
                     self._retire_replica(eng, e)
             self.clock.on_step()
             self.stats.rounds += 1
+            self._stamp_heartbeats()
             items = []
             for eng in live:
                 if eng.retired:
@@ -977,8 +1155,9 @@ class ServeLoop:
                 if rec.status == "seated":   # not requeued mid-round
                     rec.first_step_t = t
             self._awaiting_first_step = []
+            done_now: List[int] = []
             for it in items:
-                rec = self.stats.records[it.position]
+                rec = self._rec_by_pos[it.position]
                 rec.done_t = t
                 rec.done_round = self.stats.rounds
                 rec.status = "done"
@@ -988,6 +1167,7 @@ class ServeLoop:
                 self._final += 1
                 self._payloads.pop(it.position, None)
                 self.stats.completions.append(it.position)
+                done_now.append(it.position)
                 self.emit(it.position, it.host, it.row, it.tokens, it.probs)
                 # dedup fan-out delivery: the leader's settled beams are
                 # byte-identical to what every coalesced follower's own
@@ -1016,10 +1196,18 @@ class ServeLoop:
                         fr.deadline_missed = True
                     self._final += 1
                     self.stats.completions.append(fr.position)
+                    done_now.append(fr.position)
                     self.emit(fr.position, f.host, 0, it.tokens, it.probs)
+            if self._journal is not None and done_now:
+                # terminal WAL records AFTER the writer took the lines
+                # (line-buffered — on disk): one record per request, one
+                # fsync per harvest round
+                self._journal.done(done_now)
+            self._flush_shed_log()
             if (self._snapshot is not None
                     and self.stats.rounds % SNAPSHOT_EVERY_ROUNDS == 0):
                 self._snapshot(self)
+        self._flush_shed_log()   # sheds recorded after the last harvest
         self.stats.wall_s = time.perf_counter() - t0
         return self.stats
 
@@ -1041,14 +1229,19 @@ def make_clock(clock: str, *, step_cost_s: float = 1.0,
 
 
 def build_engines(model, params, cfg: FiraConfig, *, engine=None,
-                  engine_slots=None, guard=None, faults=None):
+                  engine_slots=None, guard=None, faults=None,
+                  fleet_always: bool = False):
     """Engine/fleet construction shared by the serve drivers: returns
     (owner, engines, built) — ``built`` False when the caller passed a
-    (presumably warm) ``engine`` whose prewarm must not rerun."""
+    (presumably warm) ``engine`` whose prewarm must not rerun.
+    ``fleet_always``: build an EngineFleet even at 1 replica — the
+    respawn path (robust/recovery.py) needs the fleet's replace_slot /
+    spare-pool surface, and a fleet-of-one is byte-identical to the bare
+    engine."""
     if engine is not None:
         return engine, (getattr(engine, "engines", None) or [engine]), False
     n_rep = max(1, int(cfg.engine_replicas))
-    if n_rep > 1:
+    if n_rep > 1 or fleet_always:
         from fira_tpu.parallel import fleet as fleet_lib
 
         owner = fleet_lib.EngineFleet(model, params, cfg, replicas=n_rep,
@@ -1228,7 +1421,9 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
                 engine=None,
                 faults=None,
                 metrics_path: Optional[str] = None,
-                request_mix=None) -> Dict:
+                request_mix=None,
+                journal_path: Optional[str] = None,
+                resume: bool = False) -> Dict:
     """Serve the first ``len(arrival_times)`` samples of ``split`` as an
     open-loop request stream (request ``i`` = split position ``i``,
     arriving at ``arrival_times[i]``). Writes the same position-ordered
@@ -1257,7 +1452,18 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
     entries are byte-identical requests at distinct output positions —
     the repeated-traffic regime the prefix cache / in-flight dedup
     (cfg.prefix_cache) exist for; the bench and chaos repeat legs drive
-    exactly this."""
+    exactly this.
+    ``journal_path``: when set, a write-ahead request journal (one
+    fsync'd JSONL record per request at admit and at done/shed —
+    robust/recovery.py) is maintained next to the output, making the run
+    resumable after a hard kill. ``resume``: recover a killed run —
+    finished lines are read back from the journal + the ordered writer's
+    crash pair and only the not-yet-done suffix is re-served; the final
+    output file is byte-identical to an uninterrupted run (exactly-once
+    output, docs/FAULTS.md "Recovery contracts"). Respawn (cfg
+    .max_respawns / cfg.engine_spares) arms the self-healing fleet:
+    retirements are followed by replacements instead of permanent
+    capacity loss."""
     cfg = cfg or dataset.cfg
     if faults is None:
         faults = faults_lib.injector_from(cfg)
@@ -1299,39 +1505,126 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
     else:
         table = assignment = None
 
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, output_name(ablation))
+
+    # --- crash-resume (robust/recovery.py; docs/FAULTS.md "Recovery
+    # contracts"): recover every finished line of the killed run from
+    # the journal + the ordered writer's crash pair, then re-serve
+    # EXACTLY the not-yet-done suffix — recovered positions are
+    # re-emitted verbatim, served positions are deterministic per
+    # position, so the final file is byte-identical to an uninterrupted
+    # run (exactly-once output). The recovery read happens BEFORE the
+    # writer opens (which truncates the .partial prefix).
+    from fira_tpu.robust import recovery as recovery_lib
+
+    recovered: Dict[int, str] = {}
+    remaining: Optional[np.ndarray] = None
+    if resume:
+        if not journal_path:
+            raise recovery_lib.ResumeError(
+                "resume=True requires journal_path (the write-ahead "
+                "request journal of the interrupted run)")
+        res_errs = recovery_lib.resume_errors(journal_path, n_req, times,
+                                              mix=mix)
+        if res_errs:
+            raise recovery_lib.ResumeError("; ".join(res_errs))
+        recovered = recovery_lib.recover_output(out_path, n_req)
+        remaining = np.asarray(
+            [i for i in range(n_req) if i not in recovered],
+            dtype=np.int64)
+        if not len(remaining):
+            # everything already finished: rebuild the final file from
+            # the recovered lines — no engine, no serving
+            with OrderedStreamWriter(out_path, expected=n_req) as w:
+                for p in sorted(recovered):
+                    w.add(p, recovered[p])
+            stats = ServeStats(records=[])
+            stats.resumed = n_req
+            result = {"sentence_bleu": 0.0, "n": 0.0,
+                      "output_path": out_path, "serve": stats.summary(),
+                      "engine": {}, "request_records": []}
+            if metrics_path:
+                write_metrics_atomic(metrics_path, {
+                    "serve": result["serve"], "engine": {},
+                    "request_records": []})
+                if os.path.exists(metrics_path + ".partial"):
+                    os.remove(metrics_path + ".partial")
+                result["metrics_path"] = metrics_path
+            return result
+
+    # the serving loop's view of the stream: full on a fresh run, the
+    # not-yet-done suffix (original positions kept) on a resume
+    times_loop, positions, task_mix, loop_assignment = \
+        times, None, mix, assignment
+    if remaining is not None:
+        times_loop = times[remaining]
+        positions = remaining
+        task_mix = mix[remaining] if mix is not None else remaining
+        loop_assignment = (np.asarray(assignment)[remaining]
+                           if assignment is not None else None)
+
+    # self-healing fleet (robust/recovery.py): with a respawn budget
+    # armed the engines are ALWAYS fleet-built (the fleet owns
+    # replace_slot + the warm-spare pool; a fleet-of-one is
+    # byte-identical to the bare engine)
+    respawn_armed = cfg.max_respawns > 0
     owner, engines, built = build_engines(model, params, cfg,
                                           engine=engine,
                                           engine_slots=engine_slots,
-                                          guard=guard, faults=faults)
+                                          guard=guard, faults=faults,
+                                          fleet_always=respawn_armed)
     templates = prepare_templates(owner, data, cfg, table, guard=guard,
                                   prewarm=built)
+    recovery = None
+    if respawn_armed and hasattr(owner, "replace_slot"):
+        if cfg.engine_spares:
+            owner.build_spares(cfg.engine_spares)
+        recovery = recovery_lib.RecoveryManager(
+            owner, cfg, wall_clock=(clock == "wall"))
 
-    os.makedirs(out_dir, exist_ok=True)
-    out_path = os.path.join(out_dir, output_name(ablation))
     bleu_by_pos: Dict[int, float] = {}
     snapshot = metrics_snapshotter(metrics_path, owner, faults)
+    journal = (recovery_lib.Journal(journal_path, n=n_req, times=times,
+                                    mix=mix, resume=resume)
+               if journal_path else None)
 
-    with OrderedStreamWriter(out_path, expected=n_req) as writer, \
-            Feeder(_request_tasks(data, cfg, n_req, table, assignment, mix),
-                   num_workers=cfg.feeder_workers, depth=cfg.feeder_depth,
-                   put=False,
-                   # the per-task error channel: a poisoned payload is
-                   # retried in the worker, then delivered WITH its error
-                   # for the loop to shed — never a consumer re-raise
-                   on_error="record", retries=max(0, cfg.robust_retries),
-                   faults=faults) as feed:
-        emit = sample_emitter(writer, vocab=vocab, cfg=cfg,
-                              bleu_by_pos=bleu_by_pos, n_total=n_req,
-                              var_maps=var_maps, indices=indices)
-        loop = ServeLoop(
-            engines, cfg, arrival_times=times, feed=feed, table=table,
-            assignment=assignment, templates=templates, clock=clk,
-            emit=emit,
-            # a shed request still owns its output position: an empty
-            # line keeps the file position-complete and deterministic
-            shed=lambda rec: writer.add(rec.position, "\n"),
-            refill_order=refill_order, faults=faults, snapshot=snapshot)
-        stats = run_loop_guarded(loop, snapshot)
+    try:
+        with OrderedStreamWriter(out_path, expected=n_req) as writer, \
+                Feeder(_request_tasks(data, cfg, len(times_loop), table,
+                                      loop_assignment, task_mix),
+                       num_workers=cfg.feeder_workers,
+                       depth=cfg.feeder_depth,
+                       put=False,
+                       # the per-task error channel: a poisoned payload is
+                       # retried in the worker, then delivered WITH its
+                       # error for the loop to shed — never a consumer
+                       # re-raise
+                       on_error="record",
+                       retries=max(0, cfg.robust_retries),
+                       faults=faults) as feed:
+            # resume: the recovered lines re-enter the position-keyed
+            # writer first (prefix + above-gap tails both), exactly once
+            for p in sorted(recovered):
+                writer.add(p, recovered[p])
+            emit = sample_emitter(writer, vocab=vocab, cfg=cfg,
+                                  bleu_by_pos=bleu_by_pos, n_total=n_req,
+                                  var_maps=var_maps, indices=indices)
+            loop = ServeLoop(
+                engines, cfg, arrival_times=times_loop, feed=feed,
+                table=table, assignment=loop_assignment,
+                templates=templates, clock=clk, emit=emit,
+                # a shed request still owns its output position: an empty
+                # line keeps the file position-complete and deterministic
+                shed=lambda rec: writer.add(rec.position, "\n"),
+                refill_order=refill_order, faults=faults,
+                snapshot=snapshot, positions=positions, journal=journal,
+                recovery=recovery)
+            loop.stats.resumed = len(recovered)
+            stats = run_loop_guarded(loop, snapshot)
+    finally:
+        if journal is not None:
+            journal.close()
     return finalize_serve_result(stats, owner, faults, out_path=out_path,
                                  bleu_by_pos=bleu_by_pos,
                                  metrics_path=metrics_path)

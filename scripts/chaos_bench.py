@@ -21,9 +21,29 @@ Modes:
               leg, each checked against the contracts above under the
               armed compile guard. Exit nonzero on any violation — the
               scripts/check.sh tier-1 leg.
+  --recovery-smoke
+              the SELF-HEALING contracts (robust/recovery.py; docs/
+              FAULTS.md "Recovery contracts"), each machine-checked
+              under the armed compile guard: (a) a seeded replica fault
+              mid-serve ends with a respawned replica serving and final
+              bytes identical to the no-fault run at zero post-warmup
+              compiles; (b) a warm-spare attach replaces a dead replica
+              with zero mid-run compiles; (c) a respawn storm exhausts
+              max_respawns and degrades like PR 9 (recorded sheds, no
+              hang); (d) SIGKILL mid-serve (subprocess) followed by a
+              journal resume yields bytes identical to an uninterrupted
+              run. The scripts/check.sh recovery leg.
+  --resume-child
+              internal: the subprocess the kill-mid-serve legs SIGKILL
+              (deterministic setup from --data-dir, wall-clock serve
+              with the write-ahead journal into --out-dir).
   (default)   measure throughput / shed-rate / retirement rows across
               injected fault rates, write --out (the committed artifact
-              docs/CHAOS_BENCH_r01.jsonl), echo a final JSON line.
+              docs/CHAOS_BENCH_r01.jsonl), then the RECOVERY rows —
+              capacity-restored-over-time under respawn and the
+              journal/resume overhead — into --out2 (the committed
+              artifact docs/CHAOS_BENCH_r02.jsonl); echo a final JSON
+              line with all rows.
 
 Env knobs: FIRA_CHAOS_COMMITS (measure-mode corpus size, default 240),
 FIRA_CHAOS_RATES (default "0.0,0.05,0.2" per-event fire probabilities),
@@ -44,6 +64,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 DEFAULT_OUT = os.path.join(REPO_ROOT, "docs", "CHAOS_BENCH_r01.jsonl")
+DEFAULT_OUT2 = os.path.join(REPO_ROOT, "docs", "CHAOS_BENCH_r02.jsonl")
 
 
 def _setup(n_commits: int, *, batch: int, slots: int, replicas: int = 1,
@@ -404,7 +425,337 @@ def smoke() -> int:
     return 0 if ok else 1
 
 
-def measure(out_path: str) -> int:
+# --------------------------------------------------------------------------
+# self-healing legs (robust/recovery.py; docs/FAULTS.md "Recovery
+# contracts")
+# --------------------------------------------------------------------------
+
+def _resume_setup(data_dir: str):
+    """Deterministic model/params/config over an EXISTING corpus dir —
+    shared by the kill-mid-serve parent and its subprocess child, so
+    both sides hold bit-identical params (threefry init + the eos bias
+    are pure functions of the seed)."""
+    import numpy as np
+
+    from fira_tpu.config import fira_tiny
+    from fira_tpu.data.batching import make_batch
+    from fira_tpu.data.dataset import FiraDataset
+    from fira_tpu.decode.beam import eos_biased_params
+    from fira_tpu.model.model import FiraModel
+    from fira_tpu.train.state import init_state
+
+    cfg = fira_tiny(batch_size=8, test_batch_size=6, decode_engine=True)
+    dataset = FiraDataset(data_dir, cfg)
+    cfg = dataset.cfg
+    split = dataset.splits["train"]
+    sample = make_batch(split, np.arange(min(6, len(split))), cfg,
+                        batch_size=6)
+    model = FiraModel(cfg)
+    params = eos_biased_params(init_state(model, cfg, sample).params,
+                               delta=4.0)
+    return dataset, cfg, model, params
+
+
+def resume_child(data_dir: str, out_dir: str, rate: float) -> int:
+    """The SIGKILL target: a wall-clock serve with the write-ahead
+    journal armed. The parent polls the journal for progress, kills
+    this process hard, then resumes from what survived."""
+    from fira_tpu.serve import poisson_times, serve_split
+
+    dataset, cfg, model, params = _resume_setup(data_dir)
+    n = len(dataset.splits["train"])
+    times = poisson_times(n, rate=rate, seed=3)
+    os.makedirs(out_dir, exist_ok=True)
+    serve_split(model, params, dataset, cfg, arrival_times=times,
+                out_dir=out_dir, split="train", clock="wall",
+                journal_path=os.path.join(out_dir, "output_fira.journal"))
+    return 0
+
+
+def kill_and_resume(data_dir: str, out_dir: str, *, rate: float = 8.0,
+                    min_done: int = 5, timeout_s: float = 120.0) -> dict:
+    """Spawn the child serve, SIGKILL it once >= ``min_done`` requests
+    hold terminal journal records (never a graceful shutdown), then
+    resume in-process and compare the final bytes to an uninterrupted
+    run. Returns the machine record the smoke/measure rows read."""
+    import signal
+    import subprocess
+
+    from fira_tpu.robust import recovery as recovery_lib
+    from fira_tpu.serve import poisson_times, serve_split
+
+    jp = os.path.join(out_dir, "output_fira.journal")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    os.makedirs(out_dir, exist_ok=True)
+    err_path = os.path.join(out_dir, "child_stderr.log")
+    with open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--resume-child",
+             "--data-dir", data_dir, "--child-out", out_dir,
+             "--child-rate", str(rate)],
+            stdout=subprocess.DEVNULL, stderr=err_f, env=env)
+        t0 = time.perf_counter()
+        done_at_kill = 0
+        killed = False
+        while time.perf_counter() - t0 < timeout_s:
+            if proc.poll() is not None:
+                break   # finished before we could kill: resume still
+                #         runs (a completed journal resumes to a pure
+                #         re-emit)
+            _meta, term = recovery_lib.read_journal(jp)
+            done_at_kill = len(term)
+            if done_at_kill >= min_done:
+                proc.send_signal(signal.SIGKILL)
+                killed = True
+                break
+            time.sleep(0.05)
+        if proc.poll() is None and not killed:
+            proc.send_signal(signal.SIGKILL)   # timeout backstop
+            killed = True
+        proc.wait()
+    if not killed and (proc.returncode != 0 or not os.path.exists(jp)):
+        # the child died on its own before serving anything: a FAIL
+        # verdict with its stderr, never an opaque resume traceback
+        tail = open(err_path).read()[-500:]
+        return {"n": 0, "killed": False, "done_at_kill": 0, "resumed": 0,
+                "re_served": 0, "resume_wall_s": 0.0,
+                "bytes_identical": False,
+                "child_rc": proc.returncode, "child_stderr": tail}
+
+    dataset, cfg, model, params = _resume_setup(data_dir)
+    n = len(dataset.splits["train"])
+    times = poisson_times(n, rate=rate, seed=3)
+    ref_dir = os.path.join(out_dir, "ref")
+    ref = serve_split(model, params, dataset, cfg, arrival_times=times,
+                      out_dir=ref_dir, split="train", clock="virtual")
+    t1 = time.perf_counter()
+    m = serve_split(model, params, dataset, cfg, arrival_times=times,
+                    out_dir=out_dir, split="train", clock="virtual",
+                    journal_path=jp, resume=True)
+    resume_wall = time.perf_counter() - t1
+    ref_bytes = open(ref["output_path"], "rb").read()
+    got = open(m["output_path"], "rb").read()
+    return {"n": n, "killed": killed, "done_at_kill": done_at_kill,
+            "resumed": m["serve"]["resumed"],
+            "re_served": m["serve"]["completed"] + m["serve"]["shed_error"]
+            + m["serve"]["shed_deadline"] + m["serve"]["shed_queue_full"],
+            "resume_wall_s": round(resume_wall, 3),
+            "bytes_identical": got == ref_bytes}
+
+
+def recovery_smoke() -> int:
+    """The recovery contracts, machine-checked (the check.sh leg):
+    respawn byte-identity, warm-spare attach at zero mid-run compiles,
+    respawn-storm exhaustion, SIGKILL + resume."""
+    from fira_tpu.analysis import sanitizer
+    from fira_tpu.decode.runner import run_test
+    from fira_tpu.robust import faults as faults_lib
+    from fira_tpu.serve import poisson_times, serve_split
+
+    dataset, cfg, model, params = _setup(
+        40, batch=6, slots=6, replicas=2, buckets=((16, 400, 12),),
+        dispatch_watchdog_s=0.0, robust_retries=1, fault_hang_s=1.0)
+    n = len(dataset.splits["train"])
+    times = poisson_times(n, rate=0.5, seed=3)
+    work = tempfile.mkdtemp(prefix="fira_recovery_smoke_")
+    drain = run_test(model, params, dataset, cfg,
+                     out_dir=os.path.join(work, "drain"), split="train")
+    ref_bytes = open(drain["output_path"], "rb").read()
+
+    results = []
+    ok = True
+    # (a) respawn byte-identity + (b) warm-spare attach: same seeded
+    # replica fault, once rebuilt mid-run and once spare-attached — both
+    # must end with a replacement SERVING, all requests done, bytes
+    # identical to the no-fault run, zero post-warmup compiles (a fresh
+    # replacement's prewarm compiles are its own labels' warmup)
+    for leg, spares in (("respawn:rebuild", 0), ("respawn:spare", 1)):
+        c = cfg.replace(inject_faults="engine.step:raise:0.02:18",
+                        max_respawns=3, engine_spares=spares,
+                        respawn_backoff_s=0.05)
+        inj = faults_lib.injector_from(c)
+        with sanitizer.sanitize(nans=False, infs=False) as guard:
+            m = serve_split(model, params, dataset, c, arrival_times=times,
+                            out_dir=os.path.join(work, leg.replace(":", "_")),
+                            split="train", clock="virtual", guard=guard,
+                            faults=inj)
+            extra = guard.compiles_after_warmup()
+        sv = m["serve"]
+        got = open(m["output_path"], "rb").read()
+        leg_ok = (sv["replica_retirements"] >= 1 and sv["respawns"] >= 1
+                  and sv["completed"] == n and got == ref_bytes
+                  and extra == 0
+                  and (spares == 0 or sv["spare_attaches"] >= 1))
+        ok = ok and leg_ok
+        results.append({
+            "leg": leg, "ok": leg_ok,
+            "retirements": sv["replica_retirements"],
+            "respawns": sv["respawns"],
+            "respawned": sv["respawned_replicas"],
+            "spare_attaches": sv["spare_attaches"],
+            "completed": sv["completed"],
+            "bytes_identical": got == ref_bytes,
+            "compiles_after_warmup": extra,
+            "alive_trace_len": len(sv["replicas_alive_over_time"]),
+        })
+
+    # (c) respawn storm: the fault re-fires on every replacement until
+    # max_respawns exhausts per lineage — then the run degrades exactly
+    # like PR 9 (recorded sheds, position-complete file, no hang) and
+    # every completed position still matches the no-fault bytes
+    c = cfg.replace(inject_faults="engine.step:raise:0.5:5",
+                    max_respawns=1, respawn_backoff_s=0.05)
+    inj = faults_lib.injector_from(c)
+    m = serve_split(model, params, dataset, c, arrival_times=times,
+                    out_dir=os.path.join(work, "storm"), split="train",
+                    clock="virtual", faults=inj)
+    sv = m["serve"]
+    got_lines = open(m["output_path"]).read().split("\n")
+    ref_lines = ref_bytes.decode().split("\n")
+    accounted = (sv["completed"] + sv["shed_queue_full"]
+                 + sv["shed_deadline"] + sv["shed_error"])
+    bad = _check_degraded_bytes(ref_lines, got_lines, m["request_records"])
+    leg_ok = (sv["respawns"] >= 1 and sv["replica_retirements"] >= 2
+              and accounted == n and sv["shed_error"] > 0 and not bad
+              and len(got_lines) == len(ref_lines))
+    ok = ok and leg_ok
+    results.append({
+        "leg": "respawn:storm", "ok": leg_ok,
+        "retirements": sv["replica_retirements"],
+        "respawns": sv["respawns"], "completed": sv["completed"],
+        "shed_error": sv["shed_error"],
+        **({"byte_violations": bad[:3]} if bad else {}),
+    })
+
+    # (d) SIGKILL mid-serve + journal resume: bytes identical to an
+    # uninterrupted run — exactly-once output, machine-checked
+    kr = kill_and_resume(dataset.data_dir,
+                         os.path.join(work, "kill_resume"))
+    leg_ok = kr["bytes_identical"] and kr["killed"]
+    ok = ok and leg_ok
+    results.append({"leg": "kill:resume", "ok": leg_ok, **kr})
+
+    print(json.dumps({"recovery_smoke": "ok" if ok else "FAIL",
+                      "n_requests": n, "legs": results}), flush=True)
+    return 0 if ok else 1
+
+
+def measure_recovery(out_path: str):
+    """The committed recovery rows (docs/CHAOS_BENCH_r02.jsonl):
+    capacity-restored-over-time under a seeded replica fault with
+    respawn armed, and the write-ahead journal / resume overhead."""
+    from fira_tpu.data.synthetic import write_corpus_dir
+    from fira_tpu.robust import faults as faults_lib
+    from fira_tpu.serve import poisson_times, serve_split
+
+    n_commits = int(os.environ.get("FIRA_CHAOS_COMMITS", "240"))
+    seed = int(os.environ.get("FIRA_CHAOS_SEED", "11"))
+    offered = float(os.environ.get("FIRA_CHAOS_OFFERED_RPS", "150"))
+    dataset, cfg, model, params = _setup(
+        n_commits, batch=6, slots=8, replicas=2,
+        dispatch_watchdog_s=0.0, robust_retries=1)
+    n = len(dataset.splits["train"])
+    work = tempfile.mkdtemp(prefix="fira_recovery_out_")
+    times = poisson_times(n, offered, seed=seed)
+    rows = []
+
+    # warm pass (first-use costs off the timed rows)
+    serve_split(model, params, dataset, cfg,
+                arrival_times=poisson_times(min(n, 24), offered, seed=seed),
+                out_dir=os.path.join(work, "warm"), split="train",
+                clock="wall")
+
+    # --- capacity restored over time: same seeded replica fault, PR-9
+    # degrade vs respawn — the alive trace is the control signal
+    for mode, respawns, spares in (("degrade", 0, 0), ("respawn", 3, 0),
+                                   ("spare", 3, 1)):
+        # rate/seed chosen so the fault FIRES early on this schedule
+        # (engine.step keys by a per-site dispatch counter; 0.02:11
+        # first fires at dispatches 3/74/102 — inside any serve run).
+        # The spare policy is the wall-clock story: a mid-run rebuild
+        # stalls the scheduler thread for the build+prewarm, a warm
+        # spare attaches in O(1)
+        c = cfg.replace(inject_faults=f"engine.step:raise:0.02:{seed}",
+                        max_respawns=respawns, engine_spares=spares,
+                        respawn_backoff_s=0.05)
+        inj = faults_lib.injector_from(c)
+        t0 = time.perf_counter()
+        m = serve_split(model, params, dataset, c, arrival_times=times,
+                        out_dir=os.path.join(work, f"cap_{mode}"),
+                        split="train", clock="wall", faults=inj)
+        wall = time.perf_counter() - t0
+        sv = m["serve"]
+        trace = sv["replicas_alive_over_time"]
+        restore_rounds = []
+        down_round = None
+        for prev, e in zip(trace, trace[1:]):
+            if e["alive"] < prev["alive"] and down_round is None:
+                down_round = e["round"]
+            elif e["alive"] > prev["alive"] and down_round is not None:
+                restore_rounds.append(e["round"] - down_round)
+                down_round = None
+        rows.append({
+            "mode": "recovery_capacity", "policy": mode,
+            "offered_rps": offered, "n_requests": n,
+            "wall_s": round(wall, 3),
+            "throughput_rps": sv["throughput_rps"],
+            "completed": sv["completed"], "shed_error": sv["shed_error"],
+            "retirements": sv["replica_retirements"],
+            "respawns": sv["respawns"],
+            "spare_attaches": sv["spare_attaches"],
+            "mean_restore_rounds": (round(sum(restore_rounds)
+                                          / len(restore_rounds), 2)
+                                    if restore_rounds else None),
+            "replicas_alive_over_time": trace[:50],
+            "p50_e2e_s": sv["p50_e2e_s"], "p99_e2e_s": sv["p99_e2e_s"],
+            "host": "cpu-tiny: one physical core serves every replica, "
+                    "so restored capacity adds scheduling contention, "
+                    "not throughput — the capacity signal here is "
+                    "mean_restore_rounds + the alive trace; restored "
+                    "capacity = restored throughput needs per-replica "
+                    "chips (real-accelerator geometry)",
+        })
+
+    # --- resume overhead: (a) the journal's fsync cost on an unfaulted
+    # serve, (b) a real SIGKILL + resume (subprocess)
+    t0 = time.perf_counter()
+    serve_split(model, params, dataset, cfg, arrival_times=times,
+                out_dir=os.path.join(work, "nojournal"), split="train",
+                clock="wall")
+    wall_off = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    serve_split(model, params, dataset, cfg, arrival_times=times,
+                out_dir=os.path.join(work, "journal"), split="train",
+                clock="wall",
+                journal_path=os.path.join(work, "journal",
+                                          "output_fira.journal"))
+    wall_on = time.perf_counter() - t0
+    kill_dir = os.path.join(work, "kill")
+    kdata = tempfile.mkdtemp(prefix="fira_resume_corpus_")
+    write_corpus_dir(kdata, n_commits=40, seed=13)
+    kr = kill_and_resume(kdata, kill_dir)
+    rows.append({
+        "mode": "resume_overhead", "n_requests": n,
+        "offered_rps": offered,
+        "journal_off_wall_s": round(wall_off, 3),
+        "journal_on_wall_s": round(wall_on, 3),
+        "journal_overhead_frac": round(wall_on / wall_off - 1.0, 4)
+        if wall_off else None,
+        "kill_resume": kr,
+        "host": "cpu-tiny; fsync cost is rig-dependent — the FRACTION "
+                "is the artifact",
+    })
+
+    stamp = {"generated_by": "scripts/chaos_bench.py (recovery rows)",
+             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    with open(out_path, "w") as f:
+        f.write(json.dumps(stamp) + "\n")
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return rows
+
+
+def measure(out_path: str):
     """Throughput / shed-rate / retirement rows under injected fault
     rates: the committed chaos record (docs/CHAOS_BENCH_r01.jsonl)."""
     from fira_tpu.serve import poisson_times, serve_split
@@ -470,8 +821,7 @@ def measure(out_path: str) -> int:
         f.write(json.dumps(stamp) + "\n")
         for r in rows:
             f.write(json.dumps(r) + "\n")
-    print(json.dumps({"rows": rows, "out": out_path}), flush=True)
-    return 0
+    return rows
 
 
 def main() -> int:
@@ -479,16 +829,39 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="seeded fault at each site, contract-checked "
                          "(scripts/check.sh tier-1 leg)")
+    ap.add_argument("--recovery-smoke", action="store_true",
+                    help="self-healing contracts: respawn byte-identity, "
+                         "spare attach, respawn-storm exhaustion, "
+                         "SIGKILL+resume (scripts/check.sh recovery leg)")
+    ap.add_argument("--resume-child", action="store_true",
+                    help="internal: the kill-mid-serve subprocess")
+    ap.add_argument("--data-dir", default=None,
+                    help="--resume-child: corpus dir shared with parent")
+    ap.add_argument("--child-out", default=None,
+                    help="--resume-child: serve output dir")
+    ap.add_argument("--child-rate", type=float, default=8.0,
+                    help="--resume-child: offered rate (rps)")
     ap.add_argument("--out", default=DEFAULT_OUT,
                     help=f"JSONL record path (default {DEFAULT_OUT})")
+    ap.add_argument("--out2", default=DEFAULT_OUT2,
+                    help=f"recovery-rows JSONL record path "
+                         f"(default {DEFAULT_OUT2})")
     args = ap.parse_args()
 
     from fira_tpu.utils.backend_guard import force_cpu_backend
 
     force_cpu_backend()
+    if args.resume_child:
+        return resume_child(args.data_dir, args.child_out, args.child_rate)
     if args.smoke:
         return smoke()
-    return measure(args.out)
+    if args.recovery_smoke:
+        return recovery_smoke()
+    rows = measure(args.out)
+    rows += measure_recovery(args.out2)
+    print(json.dumps({"rows": rows, "out": [args.out, args.out2]}),
+          flush=True)
+    return 0
 
 
 if __name__ == "__main__":

@@ -13,14 +13,15 @@ echo "== firacheck: static JAX-hazard scan =="
 # fira_tpu/decode/paging.py, fira_tpu/decode/prefix_cache.py,
 # fira_tpu/parallel/fleet.py,
 # fira_tpu/serve/server.py, fira_tpu/ingest/difftext.py,
-# fira_tpu/ingest/service.py, fira_tpu/robust/faults.py and
-# fira_tpu/robust/watchdog.py are named explicitly (as well as being
+# fira_tpu/ingest/service.py, fira_tpu/robust/faults.py,
+# fira_tpu/robust/watchdog.py and fira_tpu/robust/recovery.py are named
+# explicitly (as well as being
 # inside the fira_tpu tree, which the CLI dedupes): the async input
 # pipeline, the bucket packer, the grouped dispatch scheduler, the
 # slot-refill decode engine, the paged-KV arena geometry/validation, the
 # cross-request prefix cache, the replicated decode fleet, the
 # arrival-timed serving loop, the raw-diff ingest pipeline and the
-# fault-injection/watchdog machinery
+# fault-injection/watchdog/recovery machinery
 # are designated driver modules (astutil._DRIVER_FILES) whose
 # threaded/packing/refill/admission loops MUST stay in the self-scan
 # even if the directory arguments ever change.
@@ -31,7 +32,8 @@ JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu/parallel/fleet.py \
     fira_tpu/serve/server.py fira_tpu/ingest/difftext.py \
     fira_tpu/ingest/service.py fira_tpu/robust/faults.py \
-    fira_tpu/robust/watchdog.py tests scripts \
+    fira_tpu/robust/watchdog.py fira_tpu/robust/recovery.py \
+    tests scripts \
     || exit $?
 
 echo "== multichip smoke: 2 virtual CPU devices (docs/MULTICHIP.md) =="
@@ -72,6 +74,16 @@ echo "== chaos smoke: seeded fault at each site (docs/FAULTS.md) =="
 # or recorded-shed, unaffected output bytes equal to the no-fault run,
 # retirements/requeues recorded, and zero post-warmup compiles.
 JAX_PLATFORMS=cpu python scripts/chaos_bench.py --smoke || exit $?
+
+echo "== recovery smoke: retirement -> respawn -> byte-identity (docs/FAULTS.md 'Recovery contracts') =="
+# The self-healing contracts stay machine-enforced: a seeded replica
+# fault mid-serve must end with a RESPAWNED replica serving (rebuild and
+# warm-spare legs) and final output bytes identical to the no-fault run
+# at zero post-warmup compiles under the armed guard; a respawn storm
+# must exhaust max_respawns and degrade like PR 9 (recorded sheds, no
+# hang); and a SIGKILL mid-serve followed by a journal resume must yield
+# a final file byte-identical to an uninterrupted run (exactly-once).
+JAX_PLATFORMS=cpu python scripts/chaos_bench.py --recovery-smoke || exit $?
 
 echo "== tier-1 pytest (ROADMAP.md verify, verbatim) =="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
