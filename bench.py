@@ -161,7 +161,7 @@ docs/INGEST.md) next to the corpus-graph path at the same offered
 rates, with per-stage ingest latency, the ingest-stall fraction, and
 the single-worker ingest rate vs the 1,815 commits/sec/core offline
 preprocessing baseline — and folds its rows into this record; the full
-artifact lands in docs/INGEST_BENCH_r01.jsonl.
+artifact lands in docs/INGEST_BENCH_r02.jsonl.
 FIRA_BENCH_INGEST_TIMEOUT caps the sweep, default 900 s),
 
 Composed leg — the production path going forward (ISSUE 4): the stacked

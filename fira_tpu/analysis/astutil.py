@@ -56,6 +56,7 @@ _DRIVER_FILES = (
     "fira_tpu/serve/server.py",
     "fira_tpu/ingest/difftext.py",
     "fira_tpu/ingest/service.py",
+    "fira_tpu/ingest/cache.py",
     "fira_tpu/robust/faults.py",
     "fira_tpu/robust/watchdog.py",
     "fira_tpu/robust/recovery.py",

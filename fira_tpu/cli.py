@@ -215,6 +215,39 @@ def build_parser() -> argparse.ArgumentParser:
                         "request's ingest stamps; 'shed' rejects the "
                         "request with a recorded error and an empty "
                         "output line")
+    p.add_argument("--ingest-cache", default=None, choices=["on", "off"],
+                   help="serve --input diffs: the ingest fast path "
+                        "(docs/INGEST.md 'Fast path'): 'on' (default) "
+                        "content-addresses each raw diff's BYTES at "
+                        "intake — a byte-identical repeat skips the "
+                        "whole lex/AST/assemble pipeline and seats from "
+                        "an LRU of assembled payloads (its _ingest "
+                        "stamps replayed with a `cached` flag), and the "
+                        "AST stage is memoized per hunk so near-"
+                        "identical diffs reuse parsed sub-results. "
+                        "Bit-exact vs 'off' (tested + check.sh smoke); "
+                        "hits/evictions/integrity drops are metered")
+    p.add_argument("--ingest-cache-entries", type=int, default=None,
+                   metavar="N",
+                   help="whole-diff result-cache LRU capacity in cached "
+                        "request payloads (default 512; 0 = unbounded; "
+                        "must be >= 0 — validated at parse time, "
+                        "exit 2)")
+    p.add_argument("--ingest-cache-bytes", type=int, default=None,
+                   metavar="B",
+                   help="whole-diff result-cache host-memory budget in "
+                        "bytes: entries evict LRU-first until payload "
+                        "bytes fit. 0/unset = unbounded; must be >= 0 — "
+                        "validated at parse time, exit 2")
+    p.add_argument("--ingest-exec", default=None,
+                   choices=["thread", "process"],
+                   help="serve --input diffs: AST parse-stage execution "
+                        "(docs/INGEST.md 'Fast path'): 'thread' "
+                        "(default) runs it inline on the feeder "
+                        "workers; 'process' ships it to a spawned "
+                        "process pool sized by --ingest-workers — the "
+                        "GIL-bound stage's true fan-out mode (output "
+                        "bit-exact either way)")
     p.add_argument("--serve-rate", type=float, default=None, metavar="RPS",
                    help="serve: offered load in requests/second for the "
                         "open-loop Poisson arrival generator; required "
@@ -259,7 +292,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "feeder.device_put, ingest.parse, engine.prefill, "
                         "engine.step, "
                         "engine.harvest, fleet.replica, serve.admit, "
-                        "cache.lookup; "
+                        "cache.lookup, ingest.cache; "
                         "kinds: raise | hang | corrupt). Deterministic "
                         "given the seed — chaos runs replay exactly; "
                         "validated at parse time, exit 2. Off by default "
@@ -456,6 +489,14 @@ def _resolve_cfg(args):
         overrides["ingest_workers"] = args.ingest_workers
     if args.ingest_truncate is not None:
         overrides["ingest_truncate"] = args.ingest_truncate
+    if args.ingest_cache is not None:
+        overrides["ingest_cache"] = args.ingest_cache == "on"
+    if args.ingest_cache_entries is not None:
+        overrides["ingest_cache_entries"] = args.ingest_cache_entries
+    if args.ingest_cache_bytes is not None:
+        overrides["ingest_cache_bytes"] = args.ingest_cache_bytes
+    if args.ingest_exec is not None:
+        overrides["ingest_exec"] = args.ingest_exec
     if args.prefix_cache is not None:
         overrides["prefix_cache"] = args.prefix_cache == "on"
     if args.prefix_cache_entries is not None:

@@ -301,12 +301,49 @@ class FiraConfig:
     # ingest, never by a mid-loop make_batch backstop. Must be
     # clip|shed (validated at parse time, exit 2).
     ingest_truncate: str = "clip"
+    # --- ingest fast path (ingest/cache.py; docs/INGEST.md "Fast path") ---
+    # True (default) arms BOTH ingest reuse layers on the raw-diff path:
+    # (a) the whole-diff result cache — requests content-addressed by a
+    # keyed blake2b digest of the raw diff BYTES at intake, in front of
+    # lex/parse: a byte-identical repeat skips the entire lex/AST/
+    # assemble pipeline and seats from a capacity/byte-bounded LRU of
+    # assembled wire payloads, its `_ingest` stamps replayed with a
+    # `cached` flag (the PR-10 prefill cache then also fires on the same
+    # payload digest — two cache layers, one repeat); and (b) hunk-level
+    # AST memoization — the AST parse/diff stage is memoized per typed
+    # hunk content, so NEAR-identical diffs (one file changed out of
+    # many) reuse parsed sub-results with the merge re-run
+    # deterministically. Both are bit-exact: cache-on output bytes equal
+    # cache-off equal the frozen-corpus path (tests + check.sh ingest-
+    # cache smoke). False is the pristine comparator.
+    ingest_cache: bool = True
+    # Whole-diff result-cache LRU capacity in cached request entries.
+    # 0 = unbounded (the byte budget, if set, is then the only bound).
+    # Must be >= 0 (validated at parse time, CLI exit 2).
+    ingest_cache_entries: int = 512
+    # Optional host-memory budget for the whole-diff cache in bytes
+    # (assembled single-row payloads are ~tens of KB at tiny geometry,
+    # ~MB at production). 0 = unbounded. Must be >= 0 (validated at
+    # parse time, exit 2).
+    ingest_cache_bytes: int = 0
+    # Execution mode for the GIL-bound AST parse/diff stage of ingest:
+    # "thread" (default) runs it inline on the feeder worker threads
+    # (the native astdiff calls release the GIL, but the JSON/tree/edge
+    # mapping around them is pure Python); "process" ships the stage to
+    # a spawned process pool sized by the ingest worker count — the
+    # worker thread parks on the result (GIL released) while OTHER
+    # workers keep lexing/assembling, so a slow AST parse never
+    # head-of-line-blocks the next request's lex. Output is bit-exact
+    # either way (the stage is a pure function of its inputs). Must be
+    # thread|process (validated at parse time, exit 2).
+    ingest_exec: str = "thread"
 
     # --- robustness / fault injection (robust/; docs/FAULTS.md) ---
     # Seeded fault-injection spec "site:kind:rate:seed[,...]" arming named
     # injection points along the request path (sites: feeder.assemble,
     # feeder.device_put, ingest.parse, engine.prefill, engine.step,
-    # engine.harvest, fleet.replica, serve.admit, cache.lookup; kinds:
+    # engine.harvest, fleet.replica, serve.admit, cache.lookup,
+    # ingest.cache; kinds:
     # raise | hang | corrupt).
     # Deterministic given the seed — every chaos run replays exactly —
     # and validated at parse time (robust.faults.robust_errors, CLI

@@ -107,6 +107,11 @@ class FedBatch:
     queue_depth: int    # ready-but-unconsumed items when consumer arrived
     error: Optional[BaseException] = None  # FeederTaskError in record mode
     retries: int = 0    # assembly attempts beyond the first this item took
+    task_s: float = 0.0  # worker-side wall seconds of the successful
+                        # assembly attempt (task + device_put enqueue) —
+                        # the per-task cost meter the ingest worker-
+                        # scaling rows divide stall against; 0 on
+                        # error-carrying items
 
 
 class Feeder:
@@ -148,6 +153,7 @@ class Feeder:
         self._depth_min: Optional[int] = None
         self._n_task_errors = 0
         self._n_task_retries = 0
+        self._task_s = 0.0
         self._closed = False
 
         if num_workers == 0:
@@ -223,6 +229,7 @@ class Feeder:
         attempt = 0
         while True:
             try:
+                t0 = time.perf_counter()
                 if self._faults is not None:
                     self._faults.check("feeder.assemble", key=(seq, attempt))
                 host = task()
@@ -236,7 +243,8 @@ class Feeder:
                                        key=(seq, attempt))
                 device = self._device_put(host)
                 return FedBatch(seq, host, device, n_valid, 0.0, 0,
-                                retries=attempt)
+                                retries=attempt,
+                                task_s=time.perf_counter() - t0)
             except Exception as e:
                 if attempt < self._retries:
                     attempt += 1
@@ -332,6 +340,7 @@ class Feeder:
         self._depth_min = (depth_seen if self._depth_min is None
                            else min(self._depth_min, depth_seen))
         self._n_task_retries += item.retries
+        self._task_s += item.task_s
         if item.error is not None:
             self._n_task_errors += 1
 
@@ -389,6 +398,10 @@ class Feeder:
             # retry attempts absorbed in the workers
             "task_errors": float(self._n_task_errors),
             "task_retries": float(self._n_task_retries),
+            # total worker-side assembly seconds over the emitted items:
+            # task_s / (workers x wall) is pool utilization — the meter
+            # the ingest worker-scaling rows read next to stall_frac
+            "task_s": self._task_s,
         }
 
     # --- adapters ---

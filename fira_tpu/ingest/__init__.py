@@ -3,9 +3,18 @@
 ``difftext`` is the text front end (unified-diff parse/reconstruct +
 Java lexing); ``service`` is the per-request pipeline (FSM -> AST
 extraction -> frozen-vocab encode -> single-row wire payload) and the
-``serve_diffs`` / ``one_shot_message`` drivers.
+``serve_diffs`` / ``one_shot_message`` drivers; ``cache`` is the ingest
+fast path (whole-diff result cache, hunk-level AST memoization, the
+parse-stage process executor — docs/INGEST.md "Fast path").
 """
 
+from fira_tpu.ingest.cache import (  # noqa: F401
+    HunkMemo,
+    IngestCache,
+    IngestExecutor,
+    LexMemo,
+    text_digest,
+)
 from fira_tpu.ingest.difftext import (  # noqa: F401
     DiffParseError,
     DiffRequest,
@@ -17,6 +26,7 @@ from fira_tpu.ingest.difftext import (  # noqa: F401
 )
 from fira_tpu.ingest.service import (  # noqa: F401
     IngestError,
+    build_fast_path,
     ingest_errors,
     ingest_record,
     ingest_request,

@@ -1,0 +1,600 @@
+"""Ingest fast path: whole-diff result cache + hunk-level AST memoization
++ the parse-stage process executor (docs/INGEST.md "Fast path").
+
+INGEST_BENCH_r01 measured online ingest at ~4 ms/request (24% lex / 60%
+AST parse+diff / 19% assemble) — the CPU-tiny serving bottleneck
+(ingest-stall fraction 0.39-0.47). The PR-10 insight (content-address
+repeated work, share the result) applies one layer earlier than the
+prefill cache: that cache's digest is computed on the ASSEMBLED payload,
+so a repeated diff still paid the whole lex->AST->assemble pipeline
+before hitting it. This module moves the content addressing to request
+INTAKE, plus memoizes the dominant AST stage at sub-request granularity,
+plus gives the GIL-bound parse stage a real process-pool escape:
+
+- :class:`IngestCache` — the whole-diff result cache. Requests are
+  content-addressed by a KEYED blake2b digest of the raw diff text bytes
+  (:func:`text_digest` — the ``robust/faults`` keyed-digest idiom, never
+  process-salted ``hash()``), in front of lex/parse. A byte-identical
+  repeat skips the entire ingest pipeline and seats from a capacity/
+  byte-bounded LRU of assembled wire payloads; its ``_ingest`` stamps
+  are replayed from the original computation with a ``cached`` flag.
+  The PR-10 prefill cache/dedup then ALSO fires on the same payload
+  digest (``_digests`` is re-stamped per emission) — two cache layers,
+  one repeat. While a fault injector arms the ``ingest.cache`` site,
+  every entry carries a content checksum verified at lookup: a raise is
+  absorbed as a MISS (full re-ingest, bytes unchanged) and a
+  corrupt-injected read is DETECTED and dropped (re-ingest, never a
+  wrong answer) — unarmed, entries are trusted process memory, exactly
+  the ``decode/prefix_cache`` integrity discipline.
+- :class:`HunkMemo` — hunk-level AST memoization. The per-chunk
+  extraction (``preprocess.extract.update_chunk_edges`` /
+  ``normal_chunk_edges``) is a pure function of the typed chunk tokens
+  (the ingest path runs index-free), so near-identical diffs — CI
+  re-runs and bot traffic where one file changed out of many — reuse
+  parsed/diffed sub-results across requests while ``extract_commit``'s
+  rebase/merge re-runs deterministically. Keys are keyed digests of
+  (chunk type, tokens); hit accounting is separate from whole-diff hits
+  (``memo_hits``/``memo_misses`` per request, the PARTIAL-hit meter).
+  :class:`LexMemo` is the same idea for the native lexer: one bounded
+  text->tokens map, so repeated body lines (context lines are near-
+  universal repeats) lex once per process — persistent lexer state
+  shared by every ingest worker.
+- :class:`IngestExecutor` — the parse-stage execution mode behind
+  ``cfg.ingest_exec``. "thread" runs the stage inline on the feeder
+  worker thread (the native astdiff calls already release the GIL;
+  the Python around them doesn't). "process" ships the stage to a
+  SPAWNED process pool sized by the ingest worker count: the submitting
+  worker thread parks on the future (GIL released) while other workers
+  keep lexing/assembling — stage pipelining across requests, so a slow
+  AST parse never head-of-line-blocks the next request's lex. Each pool
+  process keeps its own process-local :class:`HunkMemo` (spawned
+  workers share no memory); outputs are bit-exact either way because
+  the stage is a pure function of its inputs.
+
+Equivalence contract (tests/test_ingest.py + the check.sh ingest-cache
+smoke): served output bytes are identical with ``cfg.ingest_cache`` on
+vs off vs the frozen-corpus path, at zero post-warmup retraces — every
+mechanism here is pure host work in front of already-declared program
+geometries.
+
+This module deliberately imports no JAX: it is the spawn-entry module
+for the process pool, and a pool worker must not drag a second copy of
+the device runtime up just to parse Java.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_DIGEST_KEY = b"fira-ingest-cache-v1"
+
+EXEC_MODES = ("thread", "process")
+
+# hunk-memo capacity in cached chunks (a chunk is a few hundred bytes of
+# tokens + its ChunkGraph): sized so a realistic working set of repeated
+# context/update hunks stays resident without an explicit knob
+HUNK_MEMO_ENTRIES = 4096
+# lexer-memo capacity in distinct line texts
+LEX_MEMO_ENTRIES = 8192
+
+
+def text_digest(text: str) -> str:
+    """Content address of one raw request: keyed blake2b over the diff
+    text bytes — computed at intake, BEFORE any lexing."""
+    h = hashlib.blake2b(key=_DIGEST_KEY, digest_size=16)
+    h.update(text.encode("utf-8"))
+    return h.hexdigest()
+
+
+def _payload_checksum(host: Dict) -> str:
+    """Keyed digest of a cached payload's WIRE content (name, dtype,
+    shape, bytes per array): what the ``ingest.cache`` corrupt leg must
+    be caught against. Host-only "_" keys are excluded — they are
+    replayed metadata, not served content."""
+    h = hashlib.blake2b(key=_DIGEST_KEY, digest_size=16)
+    for name in sorted(k for k in host if not k.startswith("_")):
+        a = np.ascontiguousarray(np.asarray(host[name]))  # firacheck: allow[HOST-SYNC] ingest payloads are host numpy by construction (assembled worker-side, put=False); no device value exists here
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def payload_nbytes(host: Dict) -> int:
+    return sum(int(np.asarray(v).nbytes)
+               for k, v in host.items() if not k.startswith("_"))
+
+
+@dataclasses.dataclass
+class _Entry:
+    host: Dict            # assembled payload (+ _bucket/_var/_ingest stamps)
+    checksum: Optional[str]  # wire-content digest — maintained only while
+    #                          the ingest.cache fault site is armed (its
+    #                          corrupt injection is the one writer between
+    #                          put and take; unarmed, hashing every hit
+    #                          would tax the workers the cache relieves)
+    nbytes: int
+
+
+class IngestCache:
+    """Capacity/byte-bounded LRU of assembled wire payloads, content-
+    addressed by raw-diff text digest. Shared across the feeder WORKER
+    threads (unlike the scheduler-owned prefix cache), so takes/puts are
+    lock-protected; the lock never covers an ingest computation. An
+    in-flight digest COALESCES concurrent takers onto its leader's
+    computation (see :meth:`take`), so a repeated diff re-ingests zero
+    times post-warmup under any thread schedule.
+
+    ``entries`` 0 = unbounded entry count; ``max_bytes`` 0 = unbounded
+    host bytes — both bounds honored together when set, and an
+    over-budget entry alone still lives (the cache degrades to capacity
+    one, never refuses to serve).
+    """
+
+    def __init__(self, entries: int = 512, *, max_bytes: int = 0,
+                 faults=None):
+        if int(entries) < 0:
+            raise ValueError(
+                f"ingest cache entries must be >= 0 (0 = unbounded), "
+                f"got {entries}")
+        if int(max_bytes) < 0:
+            raise ValueError(
+                f"ingest cache byte budget must be >= 0 (0 = unbounded), "
+                f"got {max_bytes}")
+        self.capacity = int(entries)
+        self.max_bytes = int(max_bytes)
+        self._lru: "collections.OrderedDict[str, _Entry]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._faults = faults
+        self._nbytes = 0
+        self._lookups = 0
+        # in-flight leadership (the PR-10 dedup idiom one layer up):
+        # digest -> Event set when the leader publishes or abandons.
+        # A concurrent taker of an in-flight digest PARKS instead of
+        # recomputing, so a repeated diff never re-ingests even when
+        # its first occurrence is still mid-pipeline on another worker
+        self._pending: Dict[str, threading.Event] = {}
+        self.hits = 0
+        self.misses = 0
+        self.coalesced = 0
+        self.fault_misses = 0
+        self.integrity_drops = 0
+        self.evictions = 0
+
+    def _integrity(self) -> bool:
+        return self._faults is not None and self._faults.armed(
+            "ingest.cache")
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def take(self, digest: str, *, fault_key=None,
+             wait_s: float = 15.0) -> Tuple[Optional[Dict], str]:
+        """(payload, outcome) — outcome one of ``hit`` / ``miss`` /
+        ``fault_miss`` (injected lookup raise, absorbed: a cache fault
+        re-ingests, never sheds) / ``integrity_drop`` (checksum caught a
+        corrupt-injected read: entry evicted, caller re-ingests). A hit
+        returns a SHALLOW copy whose ``_ingest`` stamps are replayed
+        with ``cached: True`` — the arrays themselves are shared
+        read-only (the serve loop copies rows into packed batches, it
+        never writes a payload in place).
+
+        A ``miss`` makes the caller the digest's in-flight LEADER: it
+        MUST follow with :meth:`put` (success) or :meth:`abandon`
+        (failed compute) so parked followers wake. A taker of a digest
+        that is already in flight waits for the leader instead of
+        re-ingesting (``coalesced`` metered, then the normal hit path);
+        a leader that outlives ``wait_s`` promotes the waiter to
+        CO-LEADER — duplicate compute, bit-identical result, never a
+        deadlock.
+
+        ``fault_key``: the armed ``ingest.cache`` site's event key —
+        callers pass a schedule-independent request identity (the task
+        generator passes the request position) so chaos runs replay
+        exactly, the ``robust/faults`` contract; the global lookup
+        counter is only the fallback for keyless unit-level use."""
+        parked = False
+        while True:
+            with self._lock:
+                entry = self._lru.get(digest)
+                if entry is None:
+                    ev = self._pending.get(digest)
+                    if ev is None:
+                        self._pending[digest] = threading.Event()
+                        self.misses += 1
+                        return None, "miss"
+                else:
+                    self._lookups += 1
+                    key = (fault_key if fault_key is not None
+                           else self._lookups)
+            if entry is None:
+                published = ev.wait(wait_s)
+                with self._lock:
+                    if published:
+                        # counted as coalesced only if the re-lookup
+                        # actually yields the entry — an abandon() wake
+                        # re-leads as a fresh miss, not reuse
+                        parked = True
+                    elif self._pending.get(digest) is ev:
+                        # leader presumed wedged: co-lead (its eventual
+                        # put pops the same event, so stragglers wake)
+                        self.misses += 1
+                        return None, "miss"
+                continue
+            break
+        if parked:
+            with self._lock:
+                self.coalesced += 1
+        host = entry.host
+        if self._integrity():
+            try:
+                self._faults.check("ingest.cache", key=key)
+            except Exception:
+                with self._lock:
+                    self.fault_misses += 1
+                return None, "fault_miss"
+            host = self._faults.corrupt("ingest.cache", key, host)
+            if (entry.checksum is not None
+                    and _payload_checksum(host) != entry.checksum):
+                with self._lock:
+                    if self._lru.get(digest) is entry:
+                        del self._lru[digest]
+                        self._nbytes -= entry.nbytes
+                    self.integrity_drops += 1
+                return None, "integrity_drop"
+        with self._lock:
+            if digest in self._lru:
+                self._lru.move_to_end(digest)
+            self.hits += 1
+        out = dict(host)
+        # replay the original computation's stage stamps with the
+        # `cached` flag; memo counters are ZEROED — they meter hunk
+        # reuse inside whole-diff misses, and no memo work ran on this
+        # hit (summing replayed counters would re-count the cold
+        # computation once per repeat)
+        stamps = dict(host.get("_ingest") or {}, cached=True)
+        if "memo_hits" in stamps:
+            stamps["memo_hits"] = stamps["memo_misses"] = 0
+        out["_ingest"] = stamps
+        return out, "hit"
+
+    def put(self, digest: str, host: Dict) -> int:
+        """Insert/refresh one assembled payload; returns LRU entries
+        evicted to make room. The stored dict is a shallow copy taken
+        BEFORE any fault-site corruption or digest stamping downstream
+        of the cache, so a replay is always the clean computation.
+        Publishing pops the digest's in-flight registration and wakes
+        every parked follower (their re-lookup is the normal hit)."""
+        entry = _Entry(host=dict(host),
+                       checksum=(_payload_checksum(host)
+                                 if self._integrity() else None),
+                       nbytes=payload_nbytes(host))
+        evicted = 0
+        with self._lock:
+            old = self._lru.get(digest)
+            if old is not None:
+                self._nbytes -= old.nbytes
+            self._lru[digest] = entry
+            self._lru.move_to_end(digest)
+            self._nbytes += entry.nbytes
+            while (self.capacity and len(self._lru) > self.capacity) or (
+                    self.max_bytes and self._nbytes > self.max_bytes
+                    and len(self._lru) > 1):
+                _d, e = self._lru.popitem(last=False)
+                self._nbytes -= e.nbytes
+                evicted += 1
+            self.evictions += evicted
+            ev = self._pending.pop(digest, None)
+        if ev is not None:
+            ev.set()
+        return evicted
+
+    def abandon(self, digest: str) -> None:
+        """Leader's failure path: wake parked followers WITHOUT an
+        entry — the first to re-look-up claims leadership and
+        re-ingests (a failing request never wedges its duplicates)."""
+        with self._lock:
+            ev = self._pending.pop(digest, None)
+        if ev is not None:
+            ev.set()
+
+    def clear(self) -> None:
+        """Reset to a fresh cache: entries AND meters — the bench's
+        warm-then-measure discipline clears between the untimed warm
+        pass and the timed mix, and recorded counters must describe the
+        timed mix only."""
+        with self._lock:
+            self._lru.clear()
+            self._nbytes = 0
+            self._lookups = 0
+            self.hits = self.misses = self.coalesced = 0
+            self.fault_misses = self.integrity_drops = self.evictions = 0
+
+    def summary(self) -> Dict[str, int]:
+        with self._lock:
+            total = self.hits + self.misses + self.fault_misses \
+                + self.integrity_drops
+            return {
+                "entries": len(self._lru),
+                "nbytes": self._nbytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "coalesced": self.coalesced,
+                "fault_misses": self.fault_misses,
+                "integrity_drops": self.integrity_drops,
+                "evictions": self.evictions,
+                "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            }
+
+
+class LexMemo:
+    """Persistent lexer state: one bounded text -> token-tuple map over
+    the native lexer, shared by every ingest worker in the process.
+    Context lines repeat across hunks, requests, and CI re-runs; each
+    distinct line lexes exactly once per process."""
+
+    def __init__(self, entries: int = LEX_MEMO_ENTRIES):
+        self.capacity = max(1, int(entries))
+        self._lru: "collections.OrderedDict[str, Optional[tuple]]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def __call__(self, text: str):
+        with self._lock:
+            if text in self._lru:
+                self._lru.move_to_end(text)
+                self.hits += 1
+                cached = self._lru[text]
+                return None if cached is None else list(cached)
+        from fira_tpu.preprocess import astdiff_binding as astdiff
+
+        toks = astdiff.tokenize(text)
+        with self._lock:
+            self.misses += 1
+            self._lru[text] = None if toks is None else tuple(toks)
+            while len(self._lru) > self.capacity:
+                self._lru.popitem(last=False)
+        return toks
+
+
+class HunkMemo:
+    """Hunk-level AST memoization: per-chunk extraction results keyed by
+    a keyed digest of (chunk type, tokens). The extraction is a pure
+    function of the typed chunk content on the index-free ingest path,
+    and ``extract_commit`` only READS the cached ChunkGraph while
+    rebasing into commit-global coordinates — the merge re-runs
+    deterministically per request, the parse/diff does not.
+
+    Compute runs OUTSIDE the lock (a native parse must not serialize
+    the worker pool); a duplicate-compute race inserts equal values, so
+    whichever lands last is the same value.
+    """
+
+    def __init__(self, entries: int = HUNK_MEMO_ENTRIES):
+        self.capacity = max(1, int(entries))
+        self._lru: "collections.OrderedDict[str, object]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(chunk, typ: int) -> str:
+        h = hashlib.blake2b(key=_DIGEST_KEY, digest_size=16)
+        h.update(str(typ).encode())
+        if typ == 100:
+            old, new = chunk
+            h.update("\x00".join(old).encode())
+            h.update(b"\x01")
+            h.update("\x00".join(new).encode())
+        else:
+            h.update("\x00".join(chunk).encode())
+        return h.hexdigest()
+
+    def chunk_graph(self, chunk, typ: int, commit_index=None):
+        """The memoized twin of the per-chunk extraction dispatch in
+        ``preprocess.extract.extract_commit``. ``commit_index`` joins
+        the key when set (the corpus-replication hack makes extraction
+        index-dependent; the ingest path always passes None)."""
+        return self.get_or_compute(chunk, typ, commit_index)[0]
+
+    def get_or_compute(self, chunk, typ: int, commit_index=None):
+        """(graph, hit) — the hit flag is per CALL, so a per-request
+        tally (:class:`MemoTally`) stays exact when concurrent requests
+        share this memo (global counter deltas would cross-count the
+        other request's activity)."""
+        key = self._key(chunk, typ)
+        if commit_index is not None:
+            key = f"{key}:{commit_index}"
+        with self._lock:
+            if key in self._lru:
+                self._lru.move_to_end(key)
+                self.hits += 1
+                return self._lru[key], True
+        from fira_tpu.preprocess import extract
+
+        if typ == 100:
+            g = extract.update_chunk_edges(chunk[0], chunk[1],
+                                           commit_index=commit_index)
+        else:
+            g = extract.normal_chunk_edges(list(chunk),
+                                           commit_index=commit_index)
+        with self._lock:
+            self.misses += 1
+            self._lru[key] = g
+            while len(self._lru) > self.capacity:
+                self._lru.popitem(last=False)
+        return g, False
+
+
+class MemoTally:
+    """Per-request view of a shared :class:`HunkMemo`: delegates
+    ``chunk_graph`` (the interface ``extract.extract_commit(memo=)``
+    reads) and counts THIS request's hits/misses locally — the
+    request-scoped meter the ``_ingest`` stamps record."""
+
+    __slots__ = ("_memo", "hits", "misses")
+
+    def __init__(self, memo: HunkMemo):
+        self._memo = memo
+        self.hits = 0
+        self.misses = 0
+
+    def chunk_graph(self, chunk, typ: int, commit_index=None):
+        g, hit = self._memo.get_or_compute(chunk, typ, commit_index)
+        if hit:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return g
+
+
+# --------------------------------------------------------------------------
+# the parse-stage executor (cfg.ingest_exec)
+# --------------------------------------------------------------------------
+
+# process-local state of a spawned pool worker (set by the pool
+# initializer; spawned processes share no memory with the parent, so
+# each keeps its own hunk/lex memo — reporting hit DELTAS back with
+# every result — and, for whole-request offload, its own copy of the
+# frozen vocabs + config + bucket table shipped ONCE at spawn)
+_PROC_MEMO: Optional[HunkMemo] = None
+_PROC_LEX: Optional[LexMemo] = None
+_PROC_CONTEXT: Optional[tuple] = None   # (word_vocab, ast_change_vocab,
+#                                          cfg, table)
+_PROC_EXEC: Optional["IngestExecutor"] = None  # child-local thread-mode
+#                                          executor carrying _PROC_MEMO
+
+
+def _proc_init(context=None) -> None:
+    global _PROC_MEMO, _PROC_LEX, _PROC_CONTEXT, _PROC_EXEC
+    # memos arm only when the fast path's cache knob is on (context
+    # carries the cfg): with ingest_cache off, process mode must stay
+    # the pristine comparator — fan-out without memoization. Stage-only
+    # mode (no context) keeps its memo: the executor exists to carry it.
+    arm = context is None or context[2].ingest_cache
+    _PROC_MEMO = HunkMemo() if arm else None
+    _PROC_LEX = LexMemo() if arm else None
+    _PROC_CONTEXT = context
+    _PROC_EXEC = (IngestExecutor("thread", memo=_PROC_MEMO)
+                  if _PROC_MEMO is not None else None)
+
+
+def _parse_with_memo(req, cfg, truncate, memo: Optional[HunkMemo]):
+    """The ONE parse-stage body both exec modes run: FSM + AST
+    extraction + truncation policy, with this request's memo reuse
+    counted through a request-scoped :class:`MemoTally` (exact under
+    concurrent requests sharing one memo). Returns (record, info,
+    memo_hits, memo_misses)."""
+    from fira_tpu.ingest.service import ingest_record
+
+    tally = MemoTally(memo) if memo is not None else None
+    record, info = ingest_record(req, cfg, truncate=truncate, memo=tally)
+    return (record, info,
+            tally.hits if tally is not None else 0,
+            tally.misses if tally is not None else 0)
+
+
+def _proc_parse(req, cfg, truncate):
+    """Pool-worker entry, parse stage only: FSM + AST extraction +
+    truncation policy on one parsed request. Returns (record, info,
+    memo_hits, memo_misses); policy rejections (IngestError) propagate
+    to the submitting worker exactly like the inline path."""
+    return _parse_with_memo(req, cfg, truncate, _PROC_MEMO)
+
+
+def _proc_ingest(text: str):
+    """Pool-worker entry, WHOLE-request offload: raw diff text ->
+    assembled single-row wire payload, entirely in the child (lex with
+    the child's persistent LexMemo, AST stage with its HunkMemo,
+    assemble against the spawn-shipped vocabs/config/table). The parent
+    worker thread only pickles a string out and numpy arrays back —
+    near-zero parent GIL time per request, which is what lets
+    ``ingest_workers`` actually scale past one core. DiffParseError /
+    IngestError propagate to the submitting worker unchanged."""
+    from fira_tpu.ingest.service import ingest_request
+
+    wv, acv, cfg, table = _PROC_CONTEXT
+    return ingest_request(text, wv, acv, cfg, table=table, lex=_PROC_LEX,
+                          executor=_PROC_EXEC)
+
+
+class IngestExecutor:
+    """Runs the ingest pipeline's heavy stages per ``cfg.ingest_exec``:
+    inline on the calling worker thread ("thread"), or on a spawned
+    process pool ("process") whose size follows the ingest worker
+    count. With ``context=(word_vocab, ast_change_vocab, cfg, table)``
+    the process pool does WHOLE-request offload (:meth:`ingest` — the
+    serve path), shipping the frozen context once at spawn; without it
+    only the parse stage ships (:meth:`parse`). Close() joins the pool;
+    the context manager calls it."""
+
+    def __init__(self, mode: str = "thread", *, workers: int = 2,
+                 memo: Optional[HunkMemo] = None, context=None):
+        if mode not in EXEC_MODES:
+            raise ValueError(f"ingest_exec {mode!r} not in {EXEC_MODES}")
+        self.mode = mode
+        self._memo = memo
+        self._pool = None
+        self._has_context = context is not None
+        if mode == "process":
+            import concurrent.futures
+            import multiprocessing
+
+            # spawn, not fork: the parent runs live feeder/engine threads
+            # and a forked child inheriting their lock state can deadlock;
+            # a spawned worker imports only the host-side ingest modules
+            # (this module pulls no JAX)
+            ctx = multiprocessing.get_context("spawn")
+            self._pool = concurrent.futures.ProcessPoolExecutor(
+                max_workers=max(1, int(workers)), mp_context=ctx,
+                initializer=_proc_init, initargs=(context,))
+
+    @property
+    def offloads_requests(self) -> bool:
+        """True when :meth:`ingest` ships whole requests to the pool —
+        the serve path's process mode."""
+        return self._pool is not None and self._has_context
+
+    def ingest(self, text: str):
+        """Whole-request offload: raw diff text -> assembled payload in
+        a pool worker. Only valid when constructed with ``context``."""
+        if not self.offloads_requests:
+            raise RuntimeError(
+                "IngestExecutor.ingest needs process mode with context=")
+        # .result() parks this worker thread with the GIL released;
+        # sibling workers keep shipping/serving other requests
+        return self._pool.submit(_proc_ingest, text).result()
+
+    def parse(self, req, cfg, truncate):
+        """(record, info, memo_hits, memo_misses) for one parsed
+        request — the bit-exact stage contract both modes meet."""
+        if self._pool is not None:
+            return self._pool.submit(_proc_parse, req, cfg,
+                                     truncate).result()
+        return _parse_with_memo(req, cfg, truncate, self._memo)
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __enter__(self) -> "IngestExecutor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -92,21 +92,26 @@ class DiffRequest:
     var_map: Dict[str, str]
 
 
-def _lex(text: str, where: str) -> List[str]:
+def _lex(text: str, where: str, lex=None) -> List[str]:
     if not text.strip():
         return []
-    toks = astdiff.tokenize(text)
+    toks = (lex or astdiff.tokenize)(text)
     if toks is None:
         raise DiffParseError(f"{where}: unlexable content {text!r}")
     return toks
 
 
-def parse_request(text: str) -> DiffRequest:
+def parse_request(text: str, *, lex=None) -> DiffRequest:
     """Raw request text -> :class:`DiffRequest`. Raises
     :class:`DiffParseError` (with the offending line number) on anything
     that is not a unified diff: a body line before any ``@@`` hunk
     header, an unknown marker character, malformed ``#!`` metadata, or a
-    request with no diff content at all."""
+    request with no diff content at all.
+
+    ``lex``: optional text -> tokens callable replacing the native
+    lexer — the ingest fast path passes ``ingest.cache.LexMemo`` here
+    (persistent per-process lexer state: repeated body lines lex once),
+    with identical output to the bare lexer by construction."""
     tokens: List[str] = []
     marks: List[int] = []
     msg_tokens: List[str] = []
@@ -153,7 +158,7 @@ def parse_request(text: str) -> DiffRequest:
             in_hunk = True
             section = m.group(1).strip()
             if section:
-                toks = _lex(section, f"line {ln}")
+                toks = _lex(section, f"line {ln}", lex)
                 if toks:
                     tokens += [NB] + toks + [NL]
                     marks += [2] * (len(toks) + 2)
@@ -166,7 +171,7 @@ def parse_request(text: str) -> DiffRequest:
         if not in_hunk:
             raise DiffParseError(
                 f"line {ln}: diff body line before any @@ hunk header")
-        toks = _lex(line[1:], f"line {ln}")
+        toks = _lex(line[1:], f"line {ln}", lex)
         tokens += toks
         marks += [_MARK_BY_CHAR[c]] * len(toks)
     if not tokens:
@@ -212,13 +217,20 @@ def reconstruct_diff(tokens: Sequence[str], marks: Sequence[int]) -> str:
         if t == NB:
             flush()
             run, run_mark = [], None
-            try:
-                j = toks.index(NL, i)
-            except ValueError:
-                raise ValueError(f"<nb> at {i} without closing <nl>") \
-                    from None
-            inner = list(tokens[i + 1 : j])
-            if any(m != 2 for m in marks[i : j + 1]):
+            # ONE forward walk to the closing <nl>, collecting the inner
+            # tokens and checking marks in the same scan — index() plus
+            # two re-slices walked every header block three times, and
+            # header blocks are one per hunk on many-hunk diffs
+            inner: List[str] = []
+            bad_mark = marks[i] != 2
+            j = i + 1
+            while j < n and toks[j] != NL:
+                inner.append(toks[j])
+                bad_mark = bad_mark or marks[j] != 2
+                j += 1
+            if j >= n:
+                raise ValueError(f"<nb> at {i} without closing <nl>")
+            if bad_mark or marks[j] != 2:
                 raise ValueError(f"non-context mark inside <nb> block at {i}")
             if not inner:
                 raise ValueError(

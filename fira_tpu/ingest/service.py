@@ -56,6 +56,8 @@ import numpy as np
 from fira_tpu.config import FiraConfig
 from fira_tpu.data.schema import CommitRecord
 from fira_tpu.data.vocab import PAD_ID, UNK_TOKEN, Vocab, normalize_token
+from fira_tpu.ingest.cache import (EXEC_MODES, HunkMemo, IngestCache,
+                                   IngestExecutor, LexMemo, text_digest)
 from fira_tpu.ingest.difftext import DiffRequest, parse_request
 from fira_tpu.preprocess.fsm import NB, NL, split_hunks
 from fira_tpu.preprocess.pipeline import split_sub_tokens
@@ -90,6 +92,22 @@ def ingest_errors(cfg: FiraConfig, *, input_mode: str = "graphs",
             f"truncates an over-budget diff to the config geometry "
             f"(recorded per request), 'shed' rejects it with a recorded "
             f"error")
+    if cfg.ingest_cache_entries < 0:
+        errs.append(
+            f"ingest_cache_entries {cfg.ingest_cache_entries} must be "
+            f">= 0 cached whole-diff payloads (0 = unbounded entry "
+            f"count; the LRU of the ingest result cache)")
+    if cfg.ingest_cache_bytes < 0:
+        errs.append(
+            f"ingest_cache_bytes {cfg.ingest_cache_bytes} must be >= 0 "
+            f"(0 = unbounded; otherwise the whole-diff result cache "
+            f"evicts LRU-first until its payload bytes fit)")
+    if cfg.ingest_exec not in EXEC_MODES:
+        errs.append(
+            f"ingest_exec {cfg.ingest_exec!r} must be one of "
+            f"{'/'.join(EXEC_MODES)}: 'thread' runs the AST parse stage "
+            f"inline on the feeder workers, 'process' ships it to a "
+            f"spawned process pool (the GIL-bound stage's scaling mode)")
     if command != "serve":
         return errs
     if input_mode not in ("graphs", "diffs"):
@@ -200,13 +218,18 @@ def _clip_sub_tokens(tokens: List[str], atts: List[List[str]],
 
 def ingest_record(req: DiffRequest, cfg: FiraConfig, *,
                   truncate: Optional[str] = None,
-                  commit_index: Optional[int] = None
+                  commit_index: Optional[int] = None,
+                  memo: Optional[HunkMemo] = None
                   ) -> Tuple[CommitRecord, Dict]:
     """Parsed request -> :class:`CommitRecord` + per-request info dict
     (``truncated``: what the deterministic clip dropped, or None;
     ``degraded``: the extraction error the request degraded on, or
     None). Mirrors the offline pipeline exactly for requests that FIT
-    the config geometry — the round-trip contract's precondition."""
+    the config geometry — the round-trip contract's precondition.
+
+    ``memo``: optional hunk-level AST memo (``ingest.cache.HunkMemo``)
+    — per-chunk extraction reuses cached results across near-identical
+    requests, bit-exact by purity (the rebase/merge still runs here)."""
     from fira_tpu.preprocess import extract
 
     truncate = truncate or cfg.ingest_truncate
@@ -244,7 +267,7 @@ def ingest_record(req: DiffRequest, cfg: FiraConfig, *,
     try:
         chunks, types = split_hunks(tokens, marks)
         g = extract.extract_commit(chunks, types, tokens,
-                                   commit_index=commit_index)
+                                   commit_index=commit_index, memo=memo)
         ast, change = list(g.ast), list(g.change)
         edge_ast = list(g.edge_ast)
         edge_ast_code = list(g.edge_ast_code)
@@ -309,7 +332,9 @@ def _clip_edges(ex, cfg: FiraConfig) -> Tuple[object, int]:
 def ingest_request(text: str, word_vocab: Vocab, ast_change_vocab: Vocab,
                    cfg: FiraConfig, *, table=None,
                    truncate: Optional[str] = None,
-                   batch_size: int = 1) -> Dict:
+                   batch_size: int = 1,
+                   lex=None,
+                   executor: Optional[IngestExecutor] = None) -> Dict:
     """One raw request -> its single-row wire payload (the exact
     ``make_batch(batch_size=1)`` dict the corpus serve path assembles),
     plus the host-only metadata the serving loop reads:
@@ -328,14 +353,25 @@ def ingest_request(text: str, word_vocab: Vocab, ast_change_vocab: Vocab,
     ``batch_size``: rows of the assembled batch (request row 0, the rest
     pad) — 1 for the serving loop's single-row payloads, the beam batch
     width for the one-shot ``cli message`` path.
+
+    ``lex``/``executor``: the ingest fast-path hooks (ingest/cache.py,
+    docs/INGEST.md "Fast path") — the persistent lexer memo for the lex
+    stage and the parse-stage executor (thread-inline with the hunk
+    memo, or the spawned process pool). None (default) runs the
+    pristine pipeline; outputs are bit-exact either way.
     """
     from fira_tpu.data.batching import make_batch
     from fira_tpu.data.dataset import ProcessedSplit, process_record
 
     t0 = time.perf_counter()
-    req = parse_request(text)
+    req = parse_request(text, lex=lex)
     t1 = time.perf_counter()
-    record, info = ingest_record(req, cfg, truncate=truncate)
+    memo_hits = memo_misses = 0
+    if executor is not None:
+        record, info, memo_hits, memo_misses = executor.parse(
+            req, cfg, truncate or cfg.ingest_truncate)
+    else:
+        record, info = ingest_record(req, cfg, truncate=truncate)
     t2 = time.perf_counter()
 
     words = _LenientVocab(word_vocab)
@@ -383,19 +419,70 @@ def ingest_request(text: str, word_vocab: Vocab, ast_change_vocab: Vocab,
         "oov_words": words.unk_fallbacks,
         "oov_ast": asts.pad_fallbacks,
     }
+    if executor is not None:
+        # the PARTIAL-hit meter (docs/INGEST.md "Fast path"): hunk-memo
+        # reuse inside a whole-diff MISS — accounted separately from the
+        # whole-diff `cached` flag the result cache replays
+        host["_ingest"]["memo_hits"] = memo_hits
+        host["_ingest"]["memo_misses"] = memo_misses
     return host
+
+
+def build_fast_path(cfg: FiraConfig, *, faults=None, context=None):
+    """The ingest fast-path objects for one serve run, per the knobs:
+    ``(cache, lex, executor)`` — the whole-diff result cache + lexer
+    memo (None with ``ingest_cache`` off), and the execution mode (the
+    spawned process pool under ``ingest_exec=process``; the
+    thread-inline executor carrying the hunk memo when the fast path is
+    armed; None when everything is off — the pristine legacy path).
+
+    ``context``: ``(word_vocab, ast_change_vocab, cfg, table)`` — when
+    given, the process pool does WHOLE-request offload: each cache miss
+    ships raw text out and an assembled payload back, so the parent's
+    per-request GIL time is pickling only and ``ingest_workers`` scales
+    across cores (the serve path always passes it). The caller owns
+    ``executor.close()`` (serve_diffs wraps it in a finally)."""
+    cache = lex = memo = None
+    if cfg.ingest_cache:
+        cache = IngestCache(cfg.ingest_cache_entries,
+                            max_bytes=cfg.ingest_cache_bytes,
+                            faults=faults)
+        memo = HunkMemo()
+        lex = LexMemo()
+    if cfg.ingest_exec == "process":
+        executor = IngestExecutor(
+            "process", workers=cfg.ingest_workers or cfg.feeder_workers,
+            context=context)
+    elif memo is not None:
+        executor = IngestExecutor("thread", memo=memo)
+    else:
+        executor = None
+    return cache, lex, executor
 
 
 def ingest_request_tasks(requests: Sequence[str], cfg: FiraConfig,
                          word_vocab: Vocab, ast_change_vocab: Vocab,
-                         table=None, faults=None):
+                         table=None, faults=None, cache=None, lex=None,
+                         executor: Optional[IngestExecutor] = None):
     """One ingest task per request, request order — the Feeder runs them
     on its worker pool exactly like serve._request_tasks runs corpus
     assembly: payloads are ready ahead of their arrivals, a failing
     request rides the per-task error channel into the quarantine, and
     digests are stamped worker-side when the prefix cache is armed. The
     ``ingest.parse`` fault site fires here (raise/hang before the parse,
-    corrupt on the assembled payload — each retry a fresh keyed draw)."""
+    corrupt on the assembled payload — each retry a fresh keyed draw).
+
+    ``cache``/``lex``/``executor``: the fast-path hooks from
+    :func:`build_fast_path`. With the cache armed the raw text is
+    content-addressed BEFORE any lexing: a byte-identical repeat skips
+    the whole pipeline and replays the stored payload (``_ingest``
+    stamps with ``cached: True``); the ``ingest.cache`` fault site fires
+    inside the lookup (raise => miss, corrupt => checksum-detected drop
+    => re-ingest). The cache stores the CLEAN computation — the
+    ``ingest.parse`` corrupt scramble and the prefix-cache digest stamp
+    are applied per emission, after the lookup, so fault blast radii and
+    dedup identities are byte-for-byte what the cache-off path
+    produces."""
     from fira_tpu.data.feeder import task_note
 
     stamp = None
@@ -412,8 +499,33 @@ def ingest_request_tasks(requests: Sequence[str], cfg: FiraConfig,
                 key = (i, attempts["n"])
                 attempts["n"] += 1
                 faults.check("ingest.parse", key=key)
-            host = ingest_request(text, word_vocab, ast_change_vocab, cfg,
-                                  table=table)
+            host = None
+            digest = None
+            if cache is not None:
+                digest = text_digest(text)
+                host, _outcome = cache.take(digest, fault_key=i)
+            if host is None:
+                # a miss makes this task the digest's in-flight leader:
+                # concurrent duplicates are parked inside cache.take
+                # until put (success) or abandon (the quarantine path —
+                # a failing request must not wedge its duplicates)
+                try:
+                    if executor is not None and executor.offloads_requests:
+                        # whole-request process offload: text out,
+                        # assembled payload back — the parent thread
+                        # parks GIL-free
+                        host = executor.ingest(text)
+                    else:
+                        host = ingest_request(text, word_vocab,
+                                              ast_change_vocab, cfg,
+                                              table=table, lex=lex,
+                                              executor=executor)
+                except BaseException:
+                    if cache is not None:
+                        cache.abandon(digest)
+                    raise
+                if cache is not None:
+                    cache.put(digest, host)
             if faults is not None:
                 host = faults.corrupt("ingest.parse", i, host)
             return stamp(host) if stamp is not None else host
@@ -452,7 +564,8 @@ def serve_diffs(model, params, word_vocab: Vocab, ast_change_vocab: Vocab,
                 prefill_cost_s: float = 1.0,
                 engine=None,
                 faults=None,
-                metrics_path: Optional[str] = None) -> Dict:
+                metrics_path: Optional[str] = None,
+                fast_path=None) -> Dict:
     """Serve raw-diff ``requests`` (request ``i`` arrives at
     ``arrival_times[i]``) end to end through the ServeLoop: same
     admission/deadline/shed/retirement/dedup machinery, same
@@ -518,20 +631,52 @@ def serve_diffs(model, params, word_vocab: Vocab, ast_change_vocab: Vocab,
         var_map = vm[row] if vm is not None else None
         writer.add(pos, " ".join(deanonymize(hyp, var_map)) + "\n")
 
-    with OrderedStreamWriter(out_path, expected=n_req) as writer, \
-            Feeder(ingest_request_tasks(requests, cfg, word_vocab,
-                                        ast_change_vocab, table,
-                                        faults=faults),
-                   num_workers=cfg.ingest_workers or cfg.feeder_workers,
-                   depth=cfg.feeder_depth, put=False,
-                   on_error="record", retries=max(0, cfg.robust_retries),
-                   faults=faults) as feed:
-        loop = ServeLoop(
-            engines, cfg, arrival_times=times, feed=feed, table=table,
-            assignment=None, templates=templates, clock=clk, emit=emit,
-            shed=lambda rec: writer.add(rec.position, "\n"),
-            refill_order=refill_order, faults=faults, snapshot=snapshot)
-        stats = run_loop_guarded(loop, snapshot)
+    # the ingest fast path (docs/INGEST.md "Fast path"): whole-diff
+    # result cache + lexer memo + execution mode, one set per serve
+    # run; the executor owns pool processes, so its close rides the
+    # same finally as the feeder threads. The pipeline DEPTH scales
+    # with the worker count: single-row ingest payloads are tens of KB,
+    # and a depth that caps in-flight tasks at feeder_depth=4 would
+    # idle a wide pool the moment four payloads are ready — the workers
+    # must be able to run AHEAD of arrivals (that is the whole point of
+    # pre-assembly) for fan-out to show up as stall reduction.
+    workers = cfg.ingest_workers or cfg.feeder_workers
+    depth = max(cfg.feeder_depth, 4 * max(1, workers))
+    if fast_path is not None:
+        # caller-owned reuse across runs (the engine= discipline): the
+        # caller keeps the pool warm and decides cache clearing/close
+        cache, lex, executor = fast_path
+        own_executor = None
+    else:
+        cache, lex, executor = build_fast_path(
+            cfg, faults=faults,
+            context=(word_vocab, ast_change_vocab, cfg, table))
+        own_executor = executor
+    try:
+        with OrderedStreamWriter(out_path, expected=n_req) as writer, \
+                Feeder(ingest_request_tasks(requests, cfg, word_vocab,
+                                            ast_change_vocab, table,
+                                            faults=faults, cache=cache,
+                                            lex=lex, executor=executor),
+                       num_workers=workers,
+                       depth=depth, put=False,
+                       on_error="record",
+                       retries=max(0, cfg.robust_retries),
+                       faults=faults) as feed:
+            loop = ServeLoop(
+                engines, cfg, arrival_times=times, feed=feed, table=table,
+                assignment=None, templates=templates, clock=clk, emit=emit,
+                shed=lambda rec: writer.add(rec.position, "\n"),
+                refill_order=refill_order, faults=faults, snapshot=snapshot)
+            loop.stats.ingest_pipeline = (workers, depth)
+            if cache is not None:
+                # the run-level cache meter lands in the serve summary's
+                # ingest block (entries/bytes/hits/evictions/integrity)
+                loop.stats.ingest_cache = cache.summary
+            stats = run_loop_guarded(loop, snapshot)
+    finally:
+        if own_executor is not None:
+            own_executor.close()
     return finalize_serve_result(stats, owner, faults, out_path=out_path,
                                  bleu_by_pos=bleu_by_pos,
                                  metrics_path=metrics_path)

@@ -11,8 +11,8 @@ armed by a parse-time-validated spec string
 with kinds ``raise`` (the site throws :class:`InjectedFault`), ``hang``
 (the site sleeps ``fault_hang_s`` wall seconds — the watchdog's prey),
 and ``corrupt`` (the site's host payload is deterministically scrambled
-in place, same shapes/dtypes — ``feeder.assemble`` only, the one site
-that owns a host payload). Whether a given event fires is a pure
+in place, same shapes/dtypes — the ``CORRUPT_SITES`` that own a host
+payload only). Whether a given event fires is a pure
 function of ``(seed, site, event key)`` via a keyed blake2b digest — NO
 process-global RNG, NO call-order dependence — so every chaos run
 replays exactly, thread pools included (feeder sites key by task
@@ -49,6 +49,13 @@ SITES = (
     #                       corrupt scrambles the ASSEMBLED payload (a
     #                       garbage request the downstream must serve or
     #                       shed, never crash on)
+    "ingest.cache",       # a whole-diff result-cache lookup
+    #                       (ingest/cache.py): raise => absorbed as a
+    #                       MISS (full re-ingest, bytes unchanged, never
+    #                       a shed); corrupt => the read payload is
+    #                       scrambled, the entry's content checksum
+    #                       catches it, the entry is dropped and the
+    #                       request re-ingests (never a wrong answer)
     "engine.prefill",     # the engine's prefill dispatch (admit)
     "engine.step",        # the engine's step dispatch
     "engine.harvest",     # the done-mask readback + sliced row gather
@@ -64,9 +71,10 @@ KINDS = ("raise", "hang", "corrupt")
 # corrupt scrambles a HOST payload in place; only the sites that own a
 # host payload qualify (every other site is a dispatch boundary with
 # nothing host-mutable): batch assembly, raw-diff ingest assembly, and
-# the prefix-cache read path (whose checksum must catch the scramble —
-# docs/FAULTS.md)
-CORRUPT_SITES = ("feeder.assemble", "ingest.parse", "cache.lookup")
+# the two content-cache read paths (whose checksums must catch the
+# scramble — docs/FAULTS.md)
+CORRUPT_SITES = ("feeder.assemble", "ingest.parse", "ingest.cache",
+                 "cache.lookup")
 
 
 class InjectedFault(RuntimeError):

@@ -295,6 +295,17 @@ class ServeStats:
     # stall fraction's denominator — the scheduling clock may be
     # virtual, but the stall is wall time, so the ratio must be too
     wall_s: float = 0.0
+    # ingest whole-diff result-cache meter (ingest/cache.py; raw-diff
+    # serving only): a zero-arg callable returning the cache's summary
+    # dict, bound by serve_diffs so the final summary reads the
+    # END-of-run counters — None on corpus-graph serves and with
+    # cfg.ingest_cache off
+    ingest_cache: Optional[object] = None
+    # (workers, effective pipeline depth) of the raw-diff ingest feeder
+    # — serve_diffs scales depth with the worker count past the
+    # configured feeder_depth, so the actually-applied bound is
+    # recorded rather than silently diverging from the knob
+    ingest_pipeline: Optional[tuple] = None
 
     def summary(self) -> Dict:
         done = [r for r in self.records if r.status == "done"]
@@ -359,7 +370,21 @@ class ServeStats:
                "oov_word_fallbacks": sum(int(i.get("oov_words", 0))
                                          for i in ing),
                "oov_ast_fallbacks": sum(int(i.get("oov_ast", 0))
-                                        for i in ing)}
+                                        for i in ing),
+               # the fast-path hit split (docs/INGEST.md "Fast path"):
+               # whole-diff hits replayed the stored payload (the
+               # `cached` stamp); memo hits/misses are hunk-level AST
+               # reuse INSIDE whole-diff misses — the partial-hit meter
+               "cache_hits": sum(1 for i in ing if i.get("cached")),
+               "memo_hits": sum(int(i.get("memo_hits", 0)) for i in ing),
+               "memo_misses": sum(int(i.get("memo_misses", 0))
+                                  for i in ing)}
+        if self.ingest_cache is not None:
+            out["cache"] = dict(self.ingest_cache()
+                                if callable(self.ingest_cache)
+                                else self.ingest_cache)
+        if self.ingest_pipeline is not None:
+            out["workers"], out["pipeline_depth"] = self.ingest_pipeline
         for s, vals in stage.items():
             out[f"mean_{s}"] = (round(float(np.mean(vals)), 9)
                                 if vals else None)
@@ -1333,6 +1358,10 @@ def metrics_snapshotter(metrics_path: Optional[str], owner, faults):
     if not metrics_path:
         return None
     partial_path = metrics_path + ".partial"
+    # terminal records serialize once across the run's snapshots (see
+    # _json_safe_records) — the snapshot's cost tracks the ACTIVE set,
+    # not the full request count
+    done_cache: Dict[int, Dict] = {}
 
     def snapshot(loop):
         write_metrics_atomic(partial_path, {
@@ -1340,7 +1369,8 @@ def metrics_snapshotter(metrics_path: Optional[str], owner, faults):
             "serve": loop.stats.summary(),
             "engine": owner.stats.summary(),
             **({"faults": faults.summary()} if faults else {}),
-            "request_records": _json_safe_records(loop.stats.records),
+            "request_records": _json_safe_records(loop.stats.records,
+                                                  done_cache),
         })
 
     return snapshot
@@ -1379,15 +1409,38 @@ def _request_tasks(data, cfg: FiraConfig, n: int, table, assignment,
         yield task
 
 
-def _json_safe_records(records: List[RequestRecord]) -> List[Dict]:
+_TERMINAL_STATUSES = ("done", "shed_queue_full", "shed_deadline",
+                      "shed_error")
+
+
+def _json_safe_records(records: List[RequestRecord],
+                       cache: Optional[Dict[int, Dict]] = None
+                       ) -> List[Dict]:
     """Request-record dicts with NaN lifecycle stamps (shed requests were
     never seated) serialized as null — the metrics artifact is strict
-    JSON (allow_nan=False)."""
+    JSON (allow_nan=False).
+
+    ``cache``: optional id(record) -> serialized-dict memo for the
+    periodic snapshot path. A record in a TERMINAL status never mutates
+    again, so its asdict walk (which deep-copies the per-request
+    ``_ingest``/``retries`` payload) runs once instead of once per
+    snapshot — without it the every-16-rounds snapshot re-serializes
+    every finished request's stamps for the rest of the run, an O(n) tax
+    per snapshot that profiling showed dominated by exactly this
+    dataclasses.asdict + ingest-stamp rebuild."""
     out = []
     for r in records:
+        if cache is not None:
+            hit = cache.get(id(r))
+            if hit is not None:
+                out.append(hit)
+                continue
         d = dataclasses.asdict(r)
-        out.append({k: (None if isinstance(v, float) and v != v else v)
-                    for k, v in d.items()})
+        d = {k: (None if isinstance(v, float) and v != v else v)
+             for k, v in d.items()}
+        if cache is not None and r.status in _TERMINAL_STATUSES:
+            cache[id(r)] = d
+        out.append(d)
     return out
 
 

@@ -420,6 +420,58 @@ def smoke() -> int:
             **({"byte_violations": bad[:3]} if bad else {}),
         })
 
+    # ingest.cache fault legs (docs/INGEST.md "Fast path"): the
+    # whole-diff result cache's failure contract under the armed guard,
+    # on a REPEATED diff trace (repeats are what give the cache
+    # something to fault on). raise => every lookup degrades to a MISS:
+    # full re-ingest, output bytes EXACTLY the no-fault bytes, nothing
+    # shed; corrupt => the scrambled read is caught by the entry's
+    # content checksum, the entry dropped, the request re-ingested —
+    # integrity drops metered, bytes unchanged. Never a wrong answer.
+    rep_reqs = [ing_reqs[j % 7] for j in range(36)]
+    rep_times = poisson_times(len(rep_reqs), rate=1.0, seed=3)
+    m_rep_ref = serve_diffs(model, params, dataset.word_vocab,
+                            dataset.ast_change_vocab, cfg,
+                            requests=rep_reqs, arrival_times=rep_times,
+                            out_dir=os.path.join(work, "icache_ref"),
+                            clock="virtual")
+    ref_rep_bytes = open(m_rep_ref["output_path"], "rb").read()
+    base_hits = (m_rep_ref["serve"]["ingest"].get("cache") or {}).get(
+        "hits", 0)
+    base_ok = base_hits > 0
+    ok = ok and base_ok
+    results.append({"leg": "ingest.cache:baseline", "ok": base_ok,
+                    "whole_diff_hits": base_hits})
+    for kind, seed_ in (("raise", 7), ("corrupt", 7)):
+        c = cfg.replace(inject_faults=f"ingest.cache:{kind}:0.5:{seed_}")
+        inj = faults_lib.injector_from(c)
+        with sanitizer.sanitize(nans=False, infs=False) as guard:
+            m = serve_diffs(model, params, dataset.word_vocab,
+                            dataset.ast_change_vocab, c,
+                            requests=rep_reqs, arrival_times=rep_times,
+                            out_dir=os.path.join(work, f"icache_{kind}"),
+                            clock="virtual", guard=guard, faults=inj)
+            extra_compiles = guard.compiles_after_warmup()
+        fired = sum(m.get("faults", {}).values())
+        sv = m["serve"]
+        meter = sv["ingest"].get("cache") or {}
+        leg_ok = (fired > 0 and extra_compiles == 0
+                  and sv["completed"] == len(rep_reqs)
+                  and sv["shed_error"] == 0
+                  and open(m["output_path"], "rb").read() == ref_rep_bytes
+                  and (kind != "raise" or meter.get("fault_misses", 0) > 0)
+                  and (kind != "corrupt"
+                       or meter.get("integrity_drops", 0) > 0))
+        ok = ok and leg_ok
+        results.append({
+            "leg": f"ingest.cache:{kind}", "ok": leg_ok, "fired": fired,
+            "completed": sv["completed"],
+            "whole_diff_hits": meter.get("hits"),
+            "fault_misses": meter.get("fault_misses"),
+            "integrity_drops": meter.get("integrity_drops"),
+            "compiles_after_warmup": extra_compiles,
+        })
+
     print(json.dumps({"smoke": "ok" if ok else "FAIL", "n_requests": n,
                       "legs": results}), flush=True)
     return 0 if ok else 1

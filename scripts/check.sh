@@ -13,14 +13,16 @@ echo "== firacheck: static JAX-hazard scan =="
 # fira_tpu/decode/paging.py, fira_tpu/decode/prefix_cache.py,
 # fira_tpu/parallel/fleet.py,
 # fira_tpu/serve/server.py, fira_tpu/ingest/difftext.py,
-# fira_tpu/ingest/service.py, fira_tpu/robust/faults.py,
+# fira_tpu/ingest/service.py, fira_tpu/ingest/cache.py,
+# fira_tpu/robust/faults.py,
 # fira_tpu/robust/watchdog.py and fira_tpu/robust/recovery.py are named
 # explicitly (as well as being
 # inside the fira_tpu tree, which the CLI dedupes): the async input
 # pipeline, the bucket packer, the grouped dispatch scheduler, the
 # slot-refill decode engine, the paged-KV arena geometry/validation, the
 # cross-request prefix cache, the replicated decode fleet, the
-# arrival-timed serving loop, the raw-diff ingest pipeline and the
+# arrival-timed serving loop, the raw-diff ingest pipeline (+ its
+# whole-diff result cache / hunk memo / process executor) and the
 # fault-injection/watchdog/recovery machinery
 # are designated driver modules (astutil._DRIVER_FILES) whose
 # threaded/packing/refill/admission loops MUST stay in the self-scan
@@ -31,7 +33,8 @@ JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu/decode/paging.py fira_tpu/decode/prefix_cache.py \
     fira_tpu/parallel/fleet.py \
     fira_tpu/serve/server.py fira_tpu/ingest/difftext.py \
-    fira_tpu/ingest/service.py fira_tpu/robust/faults.py \
+    fira_tpu/ingest/service.py fira_tpu/ingest/cache.py \
+    fira_tpu/robust/faults.py \
     fira_tpu/robust/watchdog.py fira_tpu/robust/recovery.py \
     tests scripts \
     || exit $?
@@ -65,6 +68,16 @@ echo "== ingest smoke: reconstructed-diff trace == corpus-path bytes (docs/INGES
 # post-warmup retraces must hold (ingest is pure host work; no new
 # program geometry exists).
 JAX_PLATFORMS=cpu python scripts/serve_bench.py --ingest-smoke || exit $?
+
+echo "== ingest-cache smoke: duplicate diff trace, cache on == cache off (docs/INGEST.md 'Fast path') =="
+# The ingest fast path stays bit-exact in tier-1: a duplicate-heavy
+# reconstructed-diff trace replayed under the armed compile guard —
+# ingest-cache-ON output bytes must equal cache-OFF bytes with real
+# whole-diff hits (every repeat served from cache, zero post-warmup
+# re-ingests) AND hunk-memo partial hits recorded, and zero post-warmup
+# compiles (the cache is pure host work in front of declared
+# geometries; no new program exists).
+JAX_PLATFORMS=cpu python scripts/serve_bench.py --ingest-cache-smoke || exit $?
 
 echo "== chaos smoke: seeded fault at each site (docs/FAULTS.md) =="
 # The graceful-degradation contracts stay machine-enforced in tier-1:

@@ -50,7 +50,7 @@ Modes:
               guard: cache-on bytes == cache-off bytes with real hits +
               coalescing and zero post-warmup compiles (the check.sh
               leg). Exit nonzero on any violation.
-  --ingest    the RAW-DIFF leg (docs/INGEST_BENCH_r01.jsonl): serve a
+  --ingest    the RAW-DIFF leg (docs/INGEST_BENCH_r02.jsonl): serve a
               trace of reconstructed unified diffs through the online
               ingest pipeline (fira_tpu/ingest — per-request diff parse
               + Java lexing + AST extraction + encode on the feeder
@@ -59,13 +59,27 @@ Modes:
               ingest-stall fraction (the feed-stall twin), and the
               single-worker ingest rate vs the offline preprocessing
               baseline (docs/PERF.md § Preprocessing, 1,815
-              commits/sec/core).
+              commits/sec/core). Round-14 grew three fast-path legs
+              (docs/INGEST.md "Fast path"): an ingest-WORKER-COUNT
+              sweep (1/2/4, thread AND process parse-stage exec) on a
+              cold repeat-0 trace — the machine-recorded scaling curve
+              — and seeded repeat-rate mixes (PR-10's Zipf _repeat_mix
+              on diff traces) served with the whole-diff result cache
+              ON vs OFF (bytes asserted identical per mix) plus one
+              composed row with the PR-10 prefill cache stacked on top.
   --ingest-smoke
               fixed reconstructed-diff trace, virtual clock, armed
               compile guard: ingest-path output bytes == corpus-path
               bytes with every request completed + stamped and zero
               post-warmup compiles (the check.sh leg). Exit nonzero on
               any violation.
+  --ingest-cache-smoke
+              duplicate-heavy reconstructed-diff trace, virtual clock,
+              armed compile guard: ingest-cache-ON output bytes ==
+              cache-OFF bytes with REAL whole-diff hits and hunk-memo
+              partial hits recorded, and zero post-warmup retraces (the
+              check.sh leg of the ingest fast-path bit-exactness
+              contract). Exit nonzero on any violation.
 
 Env knobs: FIRA_SERVE_COMMITS (synthetic corpus size, default 600),
 FIRA_SERVE_RATE_FRACS (default "0.25,0.5,0.8,1.2,1.6" x drain capacity),
@@ -78,6 +92,11 @@ FIRA_CACHE_REQUESTS (request count, default 400), FIRA_CACHE_RATE_FRACS
 (offered rates as fractions of drain capacity, default "0.5,0.8" — the
 measured SERVE_BENCH_r01 knee plus the off-arm saturation edge where
 reuse pays), FIRA_CACHE_ENTRIES (LRU capacity, default 256).
+Ingest leg: FIRA_INGEST_COMMITS (default 300), FIRA_INGEST_RATE_FRACS
+(default "0.5,0.8"), FIRA_INGEST_WORKERS (worker-sweep counts, default
+"1,2,4"), FIRA_INGEST_EXEC_MODES (parse-stage exec modes swept, default
+"thread,process"), FIRA_INGEST_REPEATS (repeat-mix rates, default
+"0.6").
 """
 
 from __future__ import annotations
@@ -95,7 +114,7 @@ sys.path.insert(0, REPO_ROOT)
 DEFAULT_OUT = os.path.join(REPO_ROOT, "docs", "SERVE_BENCH_r01.jsonl")
 DEFAULT_CACHE_OUT = os.path.join(REPO_ROOT, "docs", "CACHE_BENCH_r01.jsonl")
 DEFAULT_INGEST_OUT = os.path.join(REPO_ROOT, "docs",
-                                  "INGEST_BENCH_r01.jsonl")
+                                  "INGEST_BENCH_r02.jsonl")
 
 # the offline preprocessing baseline the online ingest rate is compared
 # against (docs/PERF.md § Preprocessing: host-side shard workers over
@@ -579,24 +598,38 @@ def ingest_smoke() -> int:
 
 
 def ingest_measure(out_path: str) -> int:
-    """The raw-diff serving leg (docs/INGEST_BENCH_r01.jsonl): drain
+    """The raw-diff serving leg (docs/INGEST_BENCH_r02.jsonl): drain
     capacity anchor, then corpus-graph vs reconstructed-diff serving at
     the same swept offered rates — per-stage ingest latency, the
     ingest-stall fraction, and the single-worker ingest rate vs the
-    offline preprocessing baseline."""
+    offline preprocessing baseline — plus the Round-14 fast-path legs:
+    the ingest-worker-count x parse-exec-mode sweep on a cold repeat-0
+    trace (the machine-recorded scaling curve) and seeded repeat-rate
+    mixes served with the whole-diff result cache ON vs OFF (bytes
+    asserted identical per mix, the repeat-traffic speedup row)."""
     from fira_tpu.data.feeder import Feeder
     from fira_tpu.decode import engine as engine_lib
     from fira_tpu.decode.runner import _decode_tasks
     from fira_tpu.ingest.service import serve_diffs
     from fira_tpu.serve import poisson_times
 
-    n_commits = int(os.environ.get("FIRA_INGEST_COMMITS", "300"))
+    # 600 commits -> a ~500-request trace: long enough that the Zipf
+    # mix's forced-fresh head amortizes (realized distinct -> ~0.4n, so
+    # the repeat legs measure the 0.6 repeat rate they claim) and
+    # end-of-stream drain effects stop dominating the short legs
+    n_commits = int(os.environ.get("FIRA_INGEST_COMMITS", "600"))
     batch = int(os.environ.get("FIRA_SERVE_BATCH", "8"))
     slots = int(os.environ.get("FIRA_SERVE_SLOTS", "16"))
     eos_delta = float(os.environ.get("FIRA_SERVE_EOS_DELTA", "4.0"))
     seed = int(os.environ.get("FIRA_SERVE_SEED", "7"))
     fracs = [float(f) for f in os.environ.get(
         "FIRA_INGEST_RATE_FRACS", "0.5,0.8").split(",")]
+    worker_counts = [int(w) for w in os.environ.get(
+        "FIRA_INGEST_WORKERS", "1,2,4").split(",")]
+    exec_modes = [m.strip() for m in os.environ.get(
+        "FIRA_INGEST_EXEC_MODES", "thread,process").split(",")]
+    repeats = [float(r) for r in os.environ.get(
+        "FIRA_INGEST_REPEATS", "0.6").split(",")]
 
     dataset, corpus, cfg, model, params = _setup(
         n_commits, batch=batch, slots=slots, eos_delta=eos_delta,
@@ -696,6 +729,224 @@ def ingest_measure(out_path: str) -> int:
                 if ingest_rps_1w else None),
         })
 
+    # --- worker-count x exec-mode sweep on a COLD (repeat-0) trace at
+    # the 0.8x drain leg: the true-fan-out scaling curve, machine-
+    # recorded. Every request is distinct, so the whole-diff cache
+    # cannot fire — stall improvements here are pure worker/exec
+    # scaling. Thread mode shares the GIL (the native astdiff calls
+    # release it; the Python around them doesn't); process mode ships
+    # WHOLE requests to a spawned pool (text out, assembled payload
+    # back — near-zero parent GIL per request). Fast paths are built
+    # once per config and WARMED by an untimed serve (the engine=
+    # warm-then-measure discipline: a spawned pool costs seconds to
+    # start, which is startup, not serving).
+    from fira_tpu.ingest.service import build_fast_path
+
+    sweep_frac = 0.8 if 0.8 in fracs else fracs[-1]
+    sweep_rate = sweep_frac * drain_rps
+    sweep_times = poisson_times(n, sweep_rate, seed=seed)
+    for mode in exec_modes:
+        for w in worker_counts:
+            c = cfg.replace(ingest_workers=w, ingest_exec=mode)
+            fp = build_fast_path(c, context=(
+                dataset.word_vocab, dataset.ast_change_vocab, c, None))
+            try:
+                serve_diffs(model, params, dataset.word_vocab,
+                            dataset.ast_change_vocab, c,
+                            requests=requests[: 6 * batch],
+                            arrival_times=sweep_times[: 6 * batch],
+                            out_dir=os.path.join(work, f"wu{mode}{w}"),
+                            engine=eng, fast_path=fp)
+                if fp[0] is not None:
+                    fp[0].clear()   # hits must be earned by the timed mix
+                eng.stats = engine_lib.EngineStats(slots=eng.slots)
+                t0 = time.perf_counter()
+                m = serve_diffs(model, params, dataset.word_vocab,
+                                dataset.ast_change_vocab, c,
+                                requests=requests,
+                                arrival_times=sweep_times,
+                                out_dir=os.path.join(work, f"w{mode}{w}"),
+                                engine=eng, fast_path=fp)
+                # wall stops BEFORE the finally joins the process pool —
+                # shutdown cost is startup bookkeeping, and folding it in
+                # would bias exactly the thread-vs-process comparison
+                wall = time.perf_counter() - t0
+            finally:
+                if fp[2] is not None:
+                    fp[2].close()
+            sv = m["serve"]
+            ing = sv["ingest"]
+            rows.append({
+                "mode": "ingest_worker_sweep", "ingest_workers": w,
+                "ingest_exec": mode, "rate_frac": round(sweep_frac, 3),
+                "offered_rps": round(sweep_rate, 3),
+                "repeat_rate": 0.0, "wall_s": round(wall, 3),
+                "completed": sv["completed"],
+                "throughput_rps": sv["throughput_rps"],
+                "p50_e2e_s": sv["p50_e2e_s"], "p99_e2e_s": sv["p99_e2e_s"],
+                "ingest_stall_s": ing["stall_s"],
+                "ingest_stall_frac": ing["stall_frac"],
+                "p50_ingest_total_s": ing["p50_total_s"],
+                "memo_hits": ing["memo_hits"],
+                "cache_hits": ing["cache_hits"],
+            })
+
+    # --- repeat-traffic legs (PR-10's Zipf _repeat_mix applied to DIFF
+    # traces): the whole-diff result cache ON vs OFF on the same
+    # repeated request stream, output bytes asserted identical per mix
+    # — the acceptance speedup row — plus one COMPOSED row stacking the
+    # PR-10 prefill cache on the same digests (two cache layers, one
+    # repeat). Offered at SATURATION (1.5x drain): the cache's win is
+    # ingest capacity, so it must be measured where ingest is the
+    # binding constraint — at sub-knee rates fan-out alone hides the
+    # pipeline and the A/B measures nothing (the CACHE_BENCH 0.8x-leg
+    # logic, one level down).
+    ok = True
+    rep_rate = 1.5 * drain_rps
+    # The cache's win is INGEST capacity, so the A/B must be read where
+    # ingest is the binding constraint in BOTH legs — which on this
+    # shared-core rig means giving the repeat legs a decode-rich serve
+    # config so the decode side approximates the accelerator asymmetry
+    # (on a real accelerator the decode path runs device-side and the
+    # host ingest is the honest bottleneck; at the sweep geometry the
+    # CPU decode ceiling caps the cache-on leg at ~1.7-1.9x and the A/B
+    # under-reads the cache). Three levers, each recorded per row:
+    # saturation-tuned serve_prefill_budget (PR-9's A/B: budget 1's
+    # stall bound costs 27% at saturation), a wider slot arena + packed
+    # admission batch (dedicated engines below), and the anchor-rate
+    # offered load at 1.5x drain. The off leg is ingest-bound and
+    # indifferent to all three.
+    rep_budget = int(os.environ.get("FIRA_INGEST_REPEAT_BUDGET", "8"))
+    rep_slots = int(os.environ.get("FIRA_INGEST_REPEAT_SLOTS", "32"))
+    rep_batch = int(os.environ.get("FIRA_INGEST_REPEAT_BATCH", "16"))
+    # ONE ingest worker in every leg: the A/B toggles exactly one
+    # variable (the cache) at fixed worker resources — worker fan-out
+    # is the OTHER lever and has its own sweep above; at the 2-worker
+    # thread default the off leg rides the native parse's released GIL
+    # to ~1.5x single-worker and the ratio conflates the two levers
+    rep_workers = int(os.environ.get("FIRA_INGEST_REPEAT_WORKERS", "1"))
+    rcfg = cfg.replace(engine_slots=rep_slots, test_batch_size=rep_batch,
+                       ingest_workers=rep_workers,
+                       serve_prefill_budget=min(rep_budget, rep_slots))
+    # the composed row stacks the PR-10 prefill cache on the same
+    # repeated payloads — the engine's prefill-artifact LRU only exists
+    # when IT was built with prefix_cache on, so the composed leg gets
+    # its own engine; both repeat engines are warmed by one untimed
+    # drain (the serve_bench warm-then-measure discipline)
+    ccfg = rcfg.replace(prefix_cache=True)
+    rep_eng = engine_lib.SlotEngine(model, params, rcfg)
+    ceng = engine_lib.SlotEngine(model, params, ccfg)
+    for e, c in ((rep_eng, rcfg), (ceng, ccfg)):
+        tasks, _ = _decode_tasks(data, c)
+        with Feeder(tasks, num_workers=c.feeder_workers,
+                    depth=c.feeder_depth) as feed:
+            for _ in e.run(feed):
+                pass
+    for repeat in repeats:
+        mix = _repeat_mix(n, repeat, n, seed=seed + 1)
+        rep_reqs = [requests[int(j)] for j in mix]
+        rep_times = poisson_times(n, rep_rate, seed=seed)
+        out_bytes = {}
+        for label, c, leg_eng in (
+                ("off", rcfg.replace(ingest_cache=False), rep_eng),
+                ("on", rcfg, rep_eng),
+                ("on+prefix", ccfg, ceng)):
+            fp = build_fast_path(c, context=(
+                dataset.word_vocab, dataset.ast_change_vocab, c, None))
+            try:
+                serve_diffs(model, params, dataset.word_vocab,
+                            dataset.ast_change_vocab, c,
+                            requests=rep_reqs[: 6 * rep_batch],
+                            arrival_times=rep_times[: 6 * rep_batch],
+                            out_dir=os.path.join(
+                                work, f"repw{repeat}_{label}"),
+                            engine=leg_eng, fast_path=fp)
+                if fp[0] is not None:
+                    fp[0].clear()
+                leg_eng.stats = engine_lib.EngineStats(
+                    slots=leg_eng.slots)
+                leg_eng.cache_clear()
+                t0 = time.perf_counter()
+                m = serve_diffs(model, params, dataset.word_vocab,
+                                dataset.ast_change_vocab, c,
+                                requests=rep_reqs,
+                                arrival_times=rep_times,
+                                out_dir=os.path.join(
+                                    work, f"rep{repeat}_{label}"),
+                                engine=leg_eng, fast_path=fp)
+                wall = time.perf_counter() - t0   # before the pool join
+            finally:
+                if fp[2] is not None:
+                    fp[2].close()
+            sv = m["serve"]
+            ing = sv["ingest"]
+            out_bytes[label] = open(m["output_path"], "rb").read()
+            rows.append({
+                "mode": "ingest_repeat", "repeat_rate": repeat,
+                "ingest_cache": label != "off",
+                "prefix_cache": label == "on+prefix",
+                "leg": label,
+                "rate_frac": 1.5,
+                "offered_rps": round(rep_rate, 3),
+                "serve_prefill_budget": min(rep_budget, rep_slots),
+                "engine_slots": rep_slots, "batch": rep_batch,
+                "ingest_workers": rep_workers,
+                "wall_s": round(wall, 3),
+                "completed": sv["completed"],
+                "throughput_rps": sv["throughput_rps"],
+                "p50_e2e_s": sv["p50_e2e_s"], "p99_e2e_s": sv["p99_e2e_s"],
+                "ingest_stall_frac": ing["stall_frac"],
+                "cache_hits": ing["cache_hits"],
+                "memo_hits": ing["memo_hits"],
+                "memo_misses": ing["memo_misses"],
+                "ingest_cache_meter": ing.get("cache"),
+                "prefill_cache_hits": m["engine"].get("cache_hits", 0),
+                "dedup_coalesced": sv["dedup_coalesced"],
+            })
+        same = (out_bytes["on"] == out_bytes["off"]
+                == out_bytes["on+prefix"])
+        ok = ok and same
+        by_leg = {r["leg"]: r for r in rows
+                  if r["mode"] == "ingest_repeat"
+                  and r.get("repeat_rate") == repeat}
+        tp = {leg: r["throughput_rps"] for leg, r in by_leg.items()}
+        speedup = (round(tp["on"] / tp["off"], 3)
+                   if tp.get("off") and tp.get("on") else None)
+        composed = (round(tp["on+prefix"] / tp["off"], 3)
+                    if tp.get("off") and tp.get("on+prefix") else None)
+        # the cache's own capacity effect, host-noise-free: full ingests
+        # the off leg pays per served request vs the on leg (1 /
+        # (1 - realized hit rate)) — the served-throughput ratio above
+        # under-reads it whenever the cache-on leg saturates the rig's
+        # DECODE ceiling instead of ingest (the one-box caveat: decode
+        # and ingest share these cores, so relieved ingest capacity
+        # beyond the decode ceiling is invisible in served rps; on a
+        # real accelerator the decode side runs device-side and the
+        # ingest relief is the serving win)
+        hits = by_leg.get("on", {}).get("cache_hits", 0)
+        capacity = (round(n / (n - hits), 3) if hits and n > hits
+                    else None)
+        rows.append({
+            "mode": "ingest_repeat_verdict", "repeat_rate": repeat,
+            "bytes_equal_on_off_composed": same,
+            "throughput_speedup_on_vs_off": speedup,
+            "throughput_speedup_composed_vs_off": composed,
+            "ingest_capacity_multiplier_on_vs_off": capacity,
+            "p50_e2e_speedup_composed_vs_off": (
+                round(by_leg["off"]["p50_e2e_s"]
+                      / by_leg["on+prefix"]["p50_e2e_s"], 3)
+                if by_leg.get("off", {}).get("p50_e2e_s")
+                and by_leg.get("on+prefix", {}).get("p50_e2e_s")
+                else None),
+            "caveat": ("one-box CPU rig: decode + ingest share cores, so "
+                       "the cache-on legs are bounded by the DECODE "
+                       "ceiling (~the graphs-path serve knee), not "
+                       "ingest; the served-throughput ratio under-reads "
+                       "the cache whenever capacity_multiplier > "
+                       "speedup. Accelerator rigs (decode device-side) "
+                       "see the capacity multiplier."),
+        })
+
     stamp = {"generated_by": "scripts/serve_bench.py --ingest",
              "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
     with open(out_path, "w") as f:
@@ -703,7 +954,71 @@ def ingest_measure(out_path: str) -> int:
         for r in rows:
             f.write(json.dumps(r) + "\n")
     print(json.dumps({"rows": rows, "out": out_path}), flush=True)
-    ok = all(r.get("bytes_equal_graphs_path", True) for r in rows)
+    ok = ok and all(r.get("bytes_equal_graphs_path", True) for r in rows)
+    return 0 if ok else 1
+
+
+def ingest_cache_smoke() -> int:
+    """Duplicate-heavy reconstructed-diff trace, virtual clock, armed
+    compile guard: ingest-cache-ON output bytes must equal cache-OFF
+    bytes with REAL reuse happening — whole-diff hits (the `cached`
+    replay) AND hunk-memo partial hits both > 0 — at zero post-warmup
+    retraces and zero post-warmup re-ingests of a repeated diff (every
+    repeat is a cache hit once warm). The check.sh tier-1 leg of the
+    ingest fast-path bit-exactness contract (docs/INGEST.md)."""
+    from fira_tpu.analysis import sanitizer
+    from fira_tpu.ingest.service import serve_diffs
+    from fira_tpu.serve import poisson_times
+
+    dataset, corpus, cfg, model, params = _setup(
+        40, batch=6, slots=6, eos_delta=4.0, buckets=((16, 400, 12),),
+        extracted=True)
+    base = _split_requests(dataset, corpus, "train")
+    n = 48
+    # duplicate-heavy fixed mix: bursts AND spaced repeats, so the
+    # whole-diff cache serves both the still-queued and the
+    # long-completed repeat shapes
+    mix = _repeat_mix(n, 0.6, len(base), seed=5)
+    requests = [base[int(j)] for j in mix]
+    times = poisson_times(n, rate=1.5, seed=3)  # virtual-clock units
+    work = tempfile.mkdtemp(prefix="fira_ingest_cache_smoke_")
+
+    ref = serve_diffs(model, params, dataset.word_vocab,
+                      dataset.ast_change_vocab,
+                      cfg.replace(ingest_cache=False),
+                      requests=requests, arrival_times=times,
+                      out_dir=os.path.join(work, "off"), clock="virtual")
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        m = serve_diffs(model, params, dataset.word_vocab,
+                        dataset.ast_change_vocab, cfg,
+                        requests=requests, arrival_times=times,
+                        out_dir=os.path.join(work, "on"),
+                        clock="virtual", guard=guard)
+        extra = guard.compiles_after_warmup()
+    got = open(m["output_path"], "rb").read()
+    exp = open(ref["output_path"], "rb").read()
+    sv = m["serve"]
+    ing = sv.get("ingest", {})
+    meter = ing.get("cache") or {}
+    n_repeat = n - len(set(mix.tolist()))
+    # zero post-warmup re-ingests: every repeated text must have hit
+    # (misses == distinct texts ingested exactly once)
+    ok = (got == exp and extra == 0 and sv["completed"] == n
+          and sv["shed_error"] == 0
+          and ing.get("cache_hits", 0) > 0
+          and ing.get("memo_hits", 0) > 0
+          and meter.get("hits", 0) == n_repeat
+          and meter.get("misses", 0) == len(set(mix.tolist())))
+    print(json.dumps({
+        "smoke": "ok" if ok else "FAIL",
+        "bytes_equal_cache_off": got == exp,
+        "compiles_after_warmup": extra,
+        "completed": sv["completed"], "offered": n,
+        "whole_diff_hits": ing.get("cache_hits"),
+        "expected_repeats": n_repeat,
+        "memo_hits": ing.get("memo_hits"),
+        "cache_meter": meter,
+    }), flush=True)
     return 0 if ok else 1
 
 
@@ -756,10 +1071,13 @@ def main() -> int:
                          "(scripts/check.sh)")
     ap.add_argument("--ingest", action="store_true",
                     help="raw-diff serving leg "
-                         "(docs/INGEST_BENCH_r01.jsonl)")
+                         "(docs/INGEST_BENCH_r02.jsonl)")
     ap.add_argument("--ingest-smoke", action="store_true",
                     help="reconstructed-diff trace == corpus-path bytes "
                          "leg (scripts/check.sh)")
+    ap.add_argument("--ingest-cache-smoke", action="store_true",
+                    help="duplicate diff trace, ingest-cache on == off "
+                         "bytes with real hits leg (scripts/check.sh)")
     ap.add_argument("--out", default=None,
                     help=f"JSONL record path (default {DEFAULT_OUT}; "
                          f"{DEFAULT_CACHE_OUT} with --cache; "
@@ -775,6 +1093,8 @@ def main() -> int:
         return cache_smoke()
     if args.ingest_smoke:
         return ingest_smoke()
+    if args.ingest_cache_smoke:
+        return ingest_cache_smoke()
     if args.cache:
         return cache_measure(args.out or DEFAULT_CACHE_OUT)
     if args.ingest:

@@ -372,3 +372,300 @@ def test_ingest_parse_is_a_registered_fault_site():
     assert specs[0].site == "ingest.parse"
     cfg = fira_tiny(inject_faults="ingest.parse:raise:0.1:7")
     assert robust_errors(cfg) == []
+
+
+# --------------------------------------------------------------------------
+# ingest fast path (ingest/cache.py; docs/INGEST.md "Fast path")
+# --------------------------------------------------------------------------
+
+def _payload_wire_bytes(host):
+    return {k: np.asarray(v).tobytes() for k, v in host.items()
+            if not k.startswith("_")}
+
+
+def test_whole_diff_cache_replays_bit_exact_with_cached_stamp(extracted):
+    """A byte-identical repeated request served through the task path
+    skips the pipeline and replays the stored payload: wire bytes,
+    bucket, and var map identical to the cold computation; its _ingest
+    stamps carry the original stage seconds plus ``cached: True``."""
+    from fira_tpu.ingest.service import (build_fast_path,
+                                         ingest_request_tasks)
+
+    corpus, dataset, cfg = extracted
+    idx = dataset.split_indices["train"]
+    text = reconstruct_request(corpus.record(int(idx[0])))
+    cache, lex, ex = build_fast_path(cfg)
+    try:
+        tasks = list(ingest_request_tasks(
+            [text, text], cfg, dataset.word_vocab,
+            dataset.ast_change_vocab, None, cache=cache, lex=lex,
+            executor=ex))
+        cold, warm = tasks[0](), tasks[1]()
+    finally:
+        if ex is not None:
+            ex.close()
+    assert _payload_wire_bytes(cold) == _payload_wire_bytes(warm)
+    assert warm["_bucket"] == cold["_bucket"]
+    assert warm["_var"] == cold["_var"]
+    assert "cached" not in cold["_ingest"]
+    assert warm["_ingest"]["cached"] is True
+    assert warm["_ingest"]["lex_s"] == cold["_ingest"]["lex_s"]
+    assert cache.hits == 1 and cache.misses == 1
+    # the pristine (cache-off) computation is byte-identical too
+    ref = ingest_request(text, dataset.word_vocab,
+                         dataset.ast_change_vocab, cfg)
+    assert _payload_wire_bytes(ref) == _payload_wire_bytes(cold)
+
+
+def test_ingest_cache_lru_eviction_deterministic():
+    """Capacity and byte bounds evict in strict LRU order, repeatably:
+    the eviction sequence is a pure function of the access sequence."""
+    from fira_tpu.ingest.cache import IngestCache
+
+    def payload(tag, nbytes=64):
+        return {"diff": np.zeros(nbytes // 8, np.int64),
+                "_ingest": {"tag": tag}}
+
+    def run_once():
+        c = IngestCache(2)
+        events = []
+        for tag in ("a", "b", "c"):          # c evicts a (capacity 2)
+            events.append(("put", tag, c.put(tag, payload(tag))))
+        events.append(("take_a", c.take("a")[1]))   # evicted -> miss
+        events.append(("take_b", c.take("b")[1]))   # hit, b -> MRU
+        events.append(("put", "d", c.put("d", payload("d"))))  # evicts c
+        events.append(("take_c", c.take("c")[1]))
+        events.append(("take_b2", c.take("b")[1]))
+        return events, sorted(c._lru)
+
+    first, keys = run_once()
+    again, keys2 = run_once()
+    assert first == again and keys == keys2 == ["b", "d"]
+    assert first == [("put", "a", 0), ("put", "b", 0), ("put", "c", 1),
+                     ("take_a", "miss"), ("take_b", "hit"),
+                     ("put", "d", 1), ("take_c", "miss"),
+                     ("take_b2", "hit")]
+    # byte budget: evict LRU-first until bytes fit, but an over-budget
+    # entry ALONE still lives (capacity degrades to one, never zero)
+    c = IngestCache(0, max_bytes=100)
+    c.put("x", payload("x", 64))
+    assert c.put("y", payload("y", 64)) == 1 and sorted(c._lru) == ["y"]
+    assert c.put("big", payload("big", 400)) == 1
+    assert sorted(c._lru) == ["big"] and len(c) == 1
+
+
+def test_ingest_cache_coalesces_inflight_duplicates():
+    """A duplicate digest taken while its leader is still computing
+    PARKS instead of re-ingesting (miss counted once, every follower a
+    hit), and a leader that fails wakes its followers to re-lead — a
+    failing request never wedges its duplicates."""
+    import threading
+
+    from fira_tpu.ingest.cache import IngestCache
+
+    c = IngestCache(8)
+    payload = {"diff": np.arange(4, dtype=np.int64)}
+    results = []
+
+    # deterministic parking signal: swap the leader's pending Event for
+    # one that releases a semaphore on wait-entry, so put() only runs
+    # once every follower has actually reached the parked wait (a sleep
+    # here would flake on a loaded machine)
+    parked = threading.Semaphore(0)
+
+    class SignalingEvent(threading.Event):
+        def wait(self, timeout=None):
+            parked.release()
+            return super().wait(timeout)
+
+    def taker(key="d"):
+        host, outcome = c.take(key)
+        results.append((outcome, host is not None))
+
+    host, outcome = c.take("d")
+    assert (host, outcome) == (None, "miss")   # this thread leads
+    with c._lock:
+        c._pending["d"] = SignalingEvent()
+    followers = [threading.Thread(target=taker) for _ in range(3)]
+    for t in followers:
+        t.start()
+    for _ in followers:
+        assert parked.acquire(timeout=5.0)     # all three at the wait
+    c.put("d", payload)
+    for t in followers:
+        t.join(5.0)
+    assert results == [("hit", True)] * 3
+    assert (c.misses, c.hits, c.coalesced) == (1, 3, 3)
+
+    # failure path: abandon wakes the follower with NO entry; it
+    # re-takes leadership (a fresh miss) instead of hanging
+    assert c.take("e") == (None, "miss")
+    with c._lock:
+        c._pending["e"] = SignalingEvent()
+    woke = []
+    t = threading.Thread(
+        target=lambda: woke.append(c.take("e", wait_s=5.0)))
+    t.start()
+    assert parked.acquire(timeout=5.0)         # follower is parked
+    c.abandon("e")
+    t.join(5.0)
+    assert woke == [(None, "miss")]
+    c.put("e", payload)         # the promoted follower's publish
+
+
+def test_hunk_memo_partial_hit_bit_exact(extracted):
+    """Two DIFFERENT diffs sharing a hunk: the second request's AST
+    stage reuses the first's parsed/diffed sub-result (memo_hits > 0 —
+    a whole-diff MISS with partial hits), and its payload is
+    byte-identical to the memo-off computation."""
+    from fira_tpu.ingest.cache import HunkMemo, IngestExecutor
+
+    _corpus, dataset, cfg = extracted
+    shared = ("@@ -1,2 +1,2 @@ class Shared\n"
+              "-int count = 1 ;\n"
+              "+int count = 2 ;\n")
+    d1 = ("diff --git a/A.java b/A.java\n--- a/A.java\n+++ b/A.java\n"
+          + shared)
+    d2 = ("diff --git a/A.java b/A.java\n--- a/A.java\n+++ b/A.java\n"
+          + shared
+          + "@@ -9,2 +9,2 @@ class Other\n-int x = 3 ;\n+int y = 4 ;\n")
+    with IngestExecutor("thread", memo=HunkMemo()) as ex:
+        first = ingest_request(d1, dataset.word_vocab,
+                               dataset.ast_change_vocab, cfg, executor=ex)
+        second = ingest_request(d2, dataset.word_vocab,
+                                dataset.ast_change_vocab, cfg,
+                                executor=ex)
+    assert first["_ingest"]["memo_hits"] == 0
+    assert second["_ingest"]["memo_hits"] > 0       # the shared hunk
+    assert second["_ingest"]["memo_misses"] > 0     # the novel hunk
+    ref = ingest_request(d2, dataset.word_vocab,
+                         dataset.ast_change_vocab, cfg)
+    assert _payload_wire_bytes(ref) == _payload_wire_bytes(second)
+
+
+def test_process_exec_parse_stage_bit_exact(extracted):
+    """cfg.ingest_exec=process ships the AST stage to a spawned pool;
+    the payload must be byte-identical to the inline computation and the
+    pool worker's process-local memo must warm across requests."""
+    from fira_tpu.ingest.cache import IngestExecutor
+
+    corpus, dataset, cfg = extracted
+    idx = dataset.split_indices["train"]
+    text = reconstruct_request(corpus.record(int(idx[0])))
+    ref = ingest_request(text, dataset.word_vocab,
+                         dataset.ast_change_vocab, cfg)
+    with IngestExecutor("process", workers=1) as ex:
+        got = ingest_request(text, dataset.word_vocab,
+                             dataset.ast_change_vocab, cfg, executor=ex)
+        again = ingest_request(text, dataset.word_vocab,
+                               dataset.ast_change_vocab, cfg, executor=ex)
+    assert _payload_wire_bytes(ref) == _payload_wire_bytes(got)
+    assert _payload_wire_bytes(ref) == _payload_wire_bytes(again)
+    assert got["_ingest"]["memo_misses"] > 0
+    assert again["_ingest"]["memo_hits"] > 0
+    assert again["_ingest"]["memo_misses"] == 0
+
+
+def test_ingest_cache_fault_raise_is_miss_corrupt_is_checksum_drop():
+    """The ingest.cache fault contract at unit level: an injected raise
+    demotes the lookup to a MISS (the caller re-ingests — bytes can't
+    change because nothing is served from the cache); an injected
+    corrupt read fails the entry's content checksum, the entry is
+    DROPPED, and the lookup degrades to a miss — never a wrong answer."""
+    from fira_tpu.ingest.cache import IngestCache
+    from fira_tpu.robust.faults import FaultInjector, parse_fault_specs
+
+    payload = {"diff": np.arange(8, dtype=np.int64),
+               "sub_token": np.arange(4, dtype=np.int64),
+               "_ingest": {"lex_s": 0.1}}
+
+    inj = FaultInjector(parse_fault_specs("ingest.cache:raise:1.0:7"))
+    c = IngestCache(8, faults=inj)
+    c.put("d", payload)
+    got, outcome = c.take("d")
+    assert got is None and outcome == "fault_miss"
+    assert c.fault_misses == 1 and "d" in c._lru  # entry intact
+
+    inj = FaultInjector(parse_fault_specs("ingest.cache:corrupt:1.0:7"))
+    c = IngestCache(8, faults=inj)
+    c.put("d", payload)
+    got, outcome = c.take("d")
+    assert got is None and outcome == "integrity_drop"
+    assert c.integrity_drops == 1 and "d" not in c._lru  # entry dropped
+    # the stored payload object was never scrambled in place
+    assert payload["diff"].tolist() == list(range(8))
+
+
+def test_ingest_cache_is_a_registered_fault_site():
+    from fira_tpu.robust.faults import (CORRUPT_SITES, SITES,
+                                        parse_fault_specs, robust_errors)
+
+    assert "ingest.cache" in SITES
+    assert "ingest.cache" in CORRUPT_SITES
+    assert parse_fault_specs("ingest.cache:corrupt:0.5:1")[0].site == \
+        "ingest.cache"
+    cfg = fira_tiny(inject_faults="ingest.cache:raise:0.1:7")
+    assert robust_errors(cfg) == []
+
+
+def test_fast_path_knob_validation_messages():
+    cfg = fira_tiny()
+    assert ingest_errors(cfg) == []
+    assert any("ingest_cache_entries" in e for e in
+               ingest_errors(cfg.replace(ingest_cache_entries=-1)))
+    assert any("ingest_cache_bytes" in e for e in
+               ingest_errors(cfg.replace(ingest_cache_bytes=-1)))
+    assert any("ingest_exec" in e for e in
+               ingest_errors(cfg.replace(ingest_exec="fork")))
+
+
+def test_cli_exit_2_on_bad_fast_path_knobs():
+    assert cli.main(["serve", "--serve-rate", "1",
+                     "--ingest-cache-entries", "-1"]) == 2
+    assert cli.main(["serve", "--serve-rate", "1",
+                     "--ingest-cache-bytes", "-5"]) == 2
+
+
+def test_many_hunk_parse_is_structurally_linear():
+    """The 200-hunk regression guard, as a NON-flaky structural check:
+    (a) parse_request lexes each content line exactly once (no
+    re-lexing across hunks), and (b) total Python function-call counts
+    for parse+reconstruct scale ~linearly from 100 to 200 hunks
+    (quadratic would show a ~4x ratio; linear ~2x). Call counts are
+    deterministic — no wall-clock assert."""
+    import cProfile
+
+    def mk(h):
+        parts = ["diff --git a/F.java b/F.java", "--- a/F.java",
+                 "+++ b/F.java"]
+        for i in range(h):
+            parts.append(f"@@ -{i+1},2 +{i+1},2 @@ class C{i}")
+            parts.append(f"-int a{i} = {i} ;")
+            parts.append(f"+int a{i} = {i+1} ;")
+        return "\n".join(parts) + "\n"
+
+    # (a) one lex per content line: 200 section texts + 400 body lines
+    calls = {"n": 0}
+
+    def counting_lex(text):
+        from fira_tpu.preprocess import astdiff_binding
+        calls["n"] += 1
+        return astdiff_binding.tokenize(text)
+
+    req = parse_request(mk(200), lex=counting_lex)
+    assert calls["n"] == 600
+    assert reconstruct_diff(req.tokens, req.marks)  # representable
+
+    def total_calls(h):
+        text = mk(h)
+        pr = cProfile.Profile()
+        pr.enable()
+        r = parse_request(text)
+        reconstruct_diff(r.tokens, r.marks)
+        pr.disable()
+        return sum(st[1] for st in pr.getstats())
+
+    c100, c200 = total_calls(100), total_calls(200)
+    assert c200 < 3.0 * c100, (
+        f"parse+reconstruct call count grew {c200 / c100:.2f}x from 100 "
+        f"to 200 hunks — a super-linear scan crept back into difftext")
