@@ -9,13 +9,21 @@ contracts:
 
 - static: ``python -m fira_tpu.analysis.cli check fira_tpu tests scripts``
   walks the AST of every file and emits ``file:line [RULE-ID] severity:
-  message`` findings (nonzero exit on errors). Rules: HOST-SYNC, RETRACE,
-  DONATION, PRNG-REUSE, DISCARDED-AT, GEOMETRY-DRIFT — see
-  docs/ANALYSIS.md for each rule's rationale and examples.
+  message`` findings (nonzero exit on errors; ``--json`` for the
+  machine-readable artifact, ``--rules`` for a family-scoped gate).
+  v1 rules: HOST-SYNC, RETRACE, DONATION, PRNG-REUSE, DISCARDED-AT,
+  GEOMETRY-DRIFT. v2 concurrency rules (the serving stack's bug family):
+  SHARED-MUT, RETIRED-RECHECK, SCHED-BLOCK, WALL-CLOCK, FLOAT-ORDER.
+  v2 contract lints: KNOB-VALIDATE, FAULT-SITE, DRIVER-REG — see
+  docs/ANALYSIS.md for each rule's rationale and provenance.
 - runtime: ``--sanitize`` on the train/test CLIs arms
   ``analysis.sanitizer`` — jax_debug_nans/jax_debug_infs plus a
   jax_log_compiles capture whose per-program compile-count guard raises if
-  any step after a program's first dispatch triggers a new compilation.
+  any step after a program's first dispatch triggers a new compilation,
+  plus the ThreadGuard lock-discipline sanitizer: declared threaded
+  structures (ingest cache/memos, fault accounting, the feeder channel)
+  raise on any mutation without their owning lock and record
+  lock-acquisition order to flag inversions.
 
 Deliberate boundary syncs are waived in place with
 ``# firacheck: allow[RULE-ID] <reason naming the invariant>``; a reason is

@@ -3,17 +3,24 @@
 Usage:
     python -m fira_tpu.analysis.cli check fira_tpu tests scripts
     python -m fira_tpu.analysis.cli check --no-suppress fira_tpu
+    python -m fira_tpu.analysis.cli check --json fira_tpu tests scripts
+    python -m fira_tpu.analysis.cli check --rules SHARED-MUT,FAULT-SITE fira_tpu
     python -m fira_tpu.analysis.cli list-rules
 
 ``check`` prints one ``file:line [RULE-ID] severity: message`` per finding
 and exits 1 if any ERROR survives the suppression baseline (warnings never
 gate). ``--no-suppress`` shows the raw pre-waiver findings — the view a
-reviewer uses to audit the committed baseline.
+reviewer uses to audit the committed baseline. ``--json`` emits one
+machine-readable document on stdout (per-rule counts + a findings array —
+the check.sh artifact format); ``--rules`` restricts reporting AND the
+exit status to the named rule ids, so a scan leg can gate on one rule
+family without re-litigating the whole baseline.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from typing import List, Optional
 
@@ -35,8 +42,26 @@ def build_parser() -> argparse.ArgumentParser:
                           "baselined repo may still exit 1 here")
     chk.add_argument("--quiet", action="store_true",
                      help="suppress the summary line")
+    chk.add_argument("--json", action="store_true",
+                     help="emit one machine-readable JSON document on "
+                          "stdout: {files, errors, warnings, per_rule, "
+                          "findings: [{path, line, rule, severity, "
+                          "message}]} — the check.sh artifact format. "
+                          "Exit codes are unchanged")
+    chk.add_argument("--rules", default=None, metavar="RULE[,RULE...]",
+                     help="restrict reporting and exit status to these "
+                          "rule ids (BAD-SUPPRESS and PARSE-ERROR always "
+                          "gate — a waiver typo or a broken file must "
+                          "never pass a filtered scan). Unknown ids are "
+                          "a usage error (exit 2)")
     sub.add_parser("list-rules", help="print the rule registry")
     return p
+
+
+# always-gating meta rules: a filtered scan that ignored a malformed
+# waiver or an unparseable file would report "clean" over a scan that
+# never actually ran
+_META_RULES = ("BAD-SUPPRESS", "PARSE-ERROR")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -45,6 +70,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         for rule, doc in sorted(RULES.items()):
             print(f"{rule}: {doc}")
         return 0
+
+    selected = None
+    if args.rules:
+        selected = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = sorted(selected - set(RULES))
+        if unknown:
+            print(f"firacheck: unknown rule id(s) {unknown}; known: "
+                  f"{sorted(RULES)}", file=sys.stderr)
+            return 2
+        selected |= set(_META_RULES)
 
     # resolve the file list once; check_paths' own iter_py_files pass over
     # already-resolved .py paths is a cheap isfile sweep, not a re-walk.
@@ -71,10 +106,27 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 1
     findings = engine.check_paths(files, suppress=not args.no_suppress)
-    for f in findings:
-        print(f.render())
+    if selected is not None:
+        findings = [f for f in findings if f.rule in selected]
     n_err = sum(1 for f in findings if f.severity is Severity.ERROR)
     n_warn = len(findings) - n_err
+    if args.json:
+        per_rule = {r: 0 for r in sorted(selected or RULES)}
+        for f in findings:
+            per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+        json.dump({
+            "files": len(files),
+            "errors": n_err,
+            "warnings": n_warn,
+            "per_rule": per_rule,
+            "findings": [{"path": f.path, "line": f.line, "rule": f.rule,
+                          "severity": str(f.severity),
+                          "message": f.message} for f in findings],
+        }, sys.stdout, indent=1)
+        print()
+    else:
+        for f in findings:
+            print(f.render())
     if not args.quiet:
         print(f"firacheck: {n_err} error(s), {n_warn} warning(s) over "
               f"{len(files)} file(s)", file=sys.stderr)

@@ -1,10 +1,12 @@
 """firacheck engine: file walking, two-pass analysis, suppression folding.
 
-Pass 1 collects the cross-file donating-factory registry (functions whose
-return is ``jax.jit(..., donate_argnums=...)``, e.g.
+Pass 1 collects the cross-file registries: the donating-factory registry
+(functions whose return is ``jax.jit(..., donate_argnums=...)``, e.g.
 train/step.py:jit_train_step) so DONATION reasons about call sites in
-OTHER files by name. Pass 2 runs every rule per file, then folds in the
-``# firacheck: allow[...]`` waivers.
+OTHER files by name, and the contract registry (``*_errors`` validator
+fields + the fault-site tables — rules_contracts.ContractRegistry) so
+the v2 contract lints reason across the whole scan. Pass 2 runs every
+rule per file, then folds in the ``# firacheck: allow[...]`` waivers.
 """
 
 from __future__ import annotations
@@ -13,7 +15,8 @@ import ast
 import os
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from fira_tpu.analysis import astutil, rules_purity, rules_sync, rules_trace
+from fira_tpu.analysis import (astutil, rules_concurrency, rules_contracts,
+                               rules_purity, rules_sync, rules_trace)
 from fira_tpu.analysis import suppress as suppress_lib
 from fira_tpu.analysis.findings import Finding, Severity
 
@@ -46,6 +49,8 @@ def _parse(path: str, source: str) -> Optional[ast.AST]:
 
 def check_source(path: str, source: str, *,
                  factories: Optional[Dict[str, Tuple[int, ...]]] = None,
+                 contracts: Optional[
+                     rules_contracts.ContractRegistry] = None,
                  suppress: bool = True,
                  tree: Optional[ast.AST] = None,
                  ) -> List[Finding]:
@@ -53,7 +58,9 @@ def check_source(path: str, source: str, *,
 
     With ``suppress=False`` the raw (pre-waiver) findings come back —
     the fixture test uses this to pin that every rule fires. ``tree``
-    lets check_paths reuse its registry-pass parse.
+    lets check_paths reuse its registry-pass parse. ``contracts``: the
+    cross-file contract registry; None builds one from this file alone
+    (+ the real fault-site table — the single-file fixture path).
     """
     tree = tree if tree is not None else _parse(path, source)
     if tree is None:
@@ -62,6 +69,10 @@ def check_source(path: str, source: str, *,
         return [Finding(path, 1, "PARSE-ERROR", Severity.ERROR,
                         "file does not parse; none of its invariants "
                         "were checked")]
+    if contracts is None:
+        contracts = rules_contracts.ContractRegistry()
+        rules_contracts.collect(path, tree, contracts)
+        rules_contracts.finalize(contracts)
     parents = astutil.parent_map(tree)
     spans = astutil.hot_spans(tree, path, parents)
     findings: List[Finding] = []
@@ -73,6 +84,9 @@ def check_source(path: str, source: str, *,
                                                 spans)
     findings += rules_purity.check_geometry(path, tree, source, parents,
                                             spans)
+    findings += rules_concurrency.check(path, tree, source, parents, spans)
+    findings += rules_contracts.check(path, tree, source, parents, spans,
+                                      registry=contracts)
 
     sups, bad = suppress_lib.parse_suppressions(path, source)
     if not suppress:
@@ -88,6 +102,7 @@ def check_paths(paths: Iterable[str], *, suppress: bool = True,
                 ) -> List[Finding]:
     files = iter_py_files(paths)
     factories: Dict[str, Tuple[int, ...]] = {}
+    contracts = rules_contracts.ContractRegistry()
     sources: Dict[str, str] = {}
     trees: Dict[str, ast.AST] = {}
     findings: List[Finding] = []
@@ -106,10 +121,13 @@ def check_paths(paths: Iterable[str], *, suppress: bool = True,
         if tree is not None:
             trees[path] = tree  # reused in pass 2 — parse once per file
             factories.update(rules_trace.collect_donating_factories(tree))
+            rules_contracts.collect(path, tree, contracts)
+    rules_contracts.finalize(contracts)
     for path in files:
         if path in sources:
             findings += check_source(path, sources[path],
-                                     factories=factories, suppress=suppress,
+                                     factories=factories,
+                                     contracts=contracts, suppress=suppress,
                                      tree=trees.get(path))
     return findings
 

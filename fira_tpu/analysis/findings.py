@@ -62,4 +62,38 @@ RULES = {
     "PARSE-ERROR": (
         "file could not be read or parsed, so NONE of its invariants were "
         "checked — a gating error, not a skip"),
+    # --- v2: concurrency-race rules (rules_concurrency.py) ---
+    "SHARED-MUT": (
+        "a self._x attribute written under a lock in some methods but "
+        "bare in others, or mutated bare from both a thread-entry method "
+        "and a scheduler method — an unsynchronized cross-thread write"),
+    "RETIRED-RECHECK": (
+        "shared scheduling/guard state mutated after a dispatch/readback "
+        "boundary without re-checking `retired` — an abandoned watchdog "
+        "thread races the survivors (docs/FAULTS.md)"),
+    "SCHED-BLOCK": (
+        "uncancellable blocking primitive (time.sleep, .wait()/.result()/"
+        ".join() without timeout, os.fsync) on a driver hot path outside "
+        "the sanctioned clock/backoff/lifecycle helpers"),
+    "WALL-CLOCK": (
+        "raw wall-clock read (time.time/perf_counter/monotonic) in a "
+        "module that schedules under make_clock, outside the *Clock "
+        "classes — wall time leaking into virtual-clock replay"),
+    "FLOAT-ORDER": (
+        "float += accumulation iterating a settle-ordered dict/set in a "
+        "threaded driver module — the aggregate depends on thread "
+        "interleaving in the last ulp (sum in sorted order instead)"),
+    # --- v2: serving-contract lints (rules_contracts.py) ---
+    "KNOB-VALIDATE": (
+        "a config knob set from a CLI flag with no *_errors parse-time "
+        "validator reading it and no constraining choices/type on the "
+        "flag — a bad value becomes a mid-run traceback, not exit 2"),
+    "FAULT-SITE": (
+        "a fault-injection site string not registered in robust.faults."
+        "SITES (or corrupt() on a site outside CORRUPT_SITES) — the spec "
+        "parser rejects it, so the injection point can never be armed"),
+    "DRIVER-REG": (
+        "a module dispatching jitted programs or driving engine/fleet "
+        "steppables that is not registered in astutil._DRIVER_FILES, or "
+        "a registered driver module not named in scripts/check.sh"),
 }

@@ -1,0 +1,390 @@
+"""Serving-contract lints: the repo's own merge contracts, mechanized
+(docs/ANALYSIS.md "v2: contract lints").
+
+Three registry passes over conventions every PR since 8 has maintained by
+hand — each encodes a promise some other file silently depends on:
+
+- KNOB-VALIDATE — every config knob a CLI flag writes is admitted at
+  parse time: either a ``*_errors`` validator somewhere reads
+  ``cfg.<knob>``, or the flag itself constrains its value (``choices``,
+  a validating ``type`` callable, ``store_true``). The repo's exit-2
+  contract (PR 6 review onward): a bad knob is a named parse-time
+  rejection, never a mid-run traceback.
+- FAULT-SITE — every site string handed to the fault injector
+  (``.check("x.y")`` / ``.corrupt("x.y", ...)`` / ``.armed("x.y")``) is
+  registered in ``robust.faults.SITES``, and corrupt-capable sites are
+  in ``CORRUPT_SITES``: an unregistered site arms NOTHING (the spec
+  parser rejects it), so a typo'd site silently un-tests its
+  degradation contract.
+- DRIVER-REG — every module that dispatches jitted programs
+  (``jax.jit``) or drives the engine/fleet steppables (``SlotEngine`` /
+  ``EngineFleet``) is a designated driver module
+  (``analysis.astutil._DRIVER_FILES``) AND named in
+  ``scripts/check.sh``: otherwise its dispatch loops are invisible to
+  the hot-region rules and a future check.sh refactor can drop it from
+  the scan (the PR 2-13 convention, now enforced).
+
+The cross-file state lives in :class:`ContractRegistry`, merged by the
+engine's pass 1 exactly like the donation-factory registry. When the
+scan does not include ``robust/faults.py`` (a partial scan), the site
+registry falls back to importing the real module, so subset scans never
+false-positive on registered sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from fira_tpu.analysis import astutil
+from fira_tpu.analysis.findings import Finding, Severity
+
+# argparse `type=` callables that validate nothing beyond shape
+_PLAIN_TYPES = {"int", "float", "str"}
+_INJECTOR_HINTS = ("fault", "injector")
+_STEPPABLE_NAMES = {"SlotEngine", "EngineFleet"}
+
+
+@dataclasses.dataclass
+class ContractRegistry:
+    """Cross-file contract state, merged over every scanned file."""
+
+    # cfg fields read by some `*_errors` validator function
+    validated_fields: Set[str] = dataclasses.field(default_factory=set)
+    # fault-site registry (robust/faults.py SITES / CORRUPT_SITES)
+    sites: Set[str] = dataclasses.field(default_factory=set)
+    corrupt_sites: Set[str] = dataclasses.field(default_factory=set)
+    sites_seen: bool = False  # a faults.py module was in the scan
+
+
+def _module_tuple(tree: ast.AST, name: str) -> List[Tuple[int, str]]:
+    """(line, value) per string element of a module-level ``name = (...)``
+    tuple assignment."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.append((e.lineno, e.value))
+    return out
+
+
+def collect(path: str, tree: ast.AST, registry: ContractRegistry) -> None:
+    """Pass-1 hook: fold one file's contract state into the registry."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name.endswith("_errors"):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute) \
+                        and isinstance(sub.value, ast.Name) \
+                        and sub.value.id == "cfg":
+                    registry.validated_fields.add(sub.attr)
+    if os.path.basename(path) == "faults.py":
+        sites = _module_tuple(tree, "SITES")
+        corrupt = _module_tuple(tree, "CORRUPT_SITES")
+        if sites:
+            registry.sites_seen = True
+            registry.sites.update(v for _ln, v in sites)
+            registry.corrupt_sites.update(v for _ln, v in corrupt)
+
+
+def finalize(registry: ContractRegistry) -> None:
+    """After pass 1: a scan that did not include robust/faults.py reads
+    the REAL site registry instead of flagging every site as unknown."""
+    if not registry.sites_seen:
+        try:
+            from fira_tpu.robust import faults as faults_lib
+
+            registry.sites.update(faults_lib.SITES)
+            registry.corrupt_sites.update(faults_lib.CORRUPT_SITES)
+            registry.sites_seen = True
+        except Exception:
+            pass  # no package available: FAULT-SITE stays disarmed
+
+
+# --------------------------------------------------------------------------
+# KNOB-VALIDATE
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _FlagInfo:
+    choices: bool = False
+    store_true: bool = False
+    custom_type: bool = False
+
+    @property
+    def self_validating(self) -> bool:
+        return self.choices or self.store_true or self.custom_type
+
+
+def _argparse_flags(tree: ast.AST) -> Dict[str, _FlagInfo]:
+    """dest -> constraint info for every ``add_argument`` call in the
+    file (dest derived from the first ``--option-string`` or positional
+    name, or an explicit ``dest=``)."""
+    flags: Dict[str, _FlagInfo] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument" and node.args):
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        dest = first.value.lstrip("-").replace("-", "_")
+        info = _FlagInfo()
+        for kw in node.keywords:
+            if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                dest = str(kw.value.value)
+            elif kw.arg == "choices":
+                info.choices = True
+            elif kw.arg == "action" \
+                    and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value in ("store_true", "store_false"):
+                info.store_true = True
+            elif kw.arg == "type":
+                tname = astutil.dotted(kw.value)
+                if tname is None or astutil.last_segment(tname) \
+                        not in _PLAIN_TYPES:
+                    info.custom_type = True
+        flags[dest] = info
+    return flags
+
+
+def _args_attrs(node: ast.AST) -> List[str]:
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name) \
+                and n.value.id == "args":
+            out.append(n.attr)
+    return out
+
+
+def check_knob_validate(path: str, tree: ast.AST, parents,
+                        registry: ContractRegistry) -> List[Finding]:
+    """KNOB-VALIDATE: runs in files that define ``_resolve_cfg`` (the
+    CLI's flag->config funnel). Disarmed when the scan saw NO validator
+    functions at all (a partial scan has nothing to compare against)."""
+    if not registry.validated_fields:
+        return []
+    resolve = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_resolve_cfg":
+            resolve = node
+            break
+    if resolve is None:
+        return []
+    flags = _argparse_flags(tree)
+    findings: List[Finding] = []
+    for node in ast.walk(resolve):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name)
+                and t.value.id == "overrides"
+                and isinstance(t.slice, ast.Constant)
+                and isinstance(t.slice.value, str)):
+            continue
+        field = t.slice.value
+        if field in registry.validated_fields:
+            continue
+        # which CLI flag feeds this knob: the RHS's args.<attr>, else the
+        # nearest enclosing condition's (a `store_true`-gated literal)
+        attrs = _args_attrs(node.value)
+        if not attrs:
+            for a in astutil.ancestors(node, parents):
+                if a is resolve:
+                    break
+                if isinstance(a, ast.If):
+                    attrs = _args_attrs(a.test)
+                    if attrs:
+                        break
+        covered = any(flags.get(a, _FlagInfo()).self_validating
+                      for a in attrs)
+        if not covered:
+            via = (f"--{attrs[0].replace('_', '-')}" if attrs
+                   else "a computed value")
+            findings.append(Finding(
+                path, node.lineno, "KNOB-VALIDATE", Severity.ERROR,
+                f"config knob '{field}' is set from the CLI ({via}) but "
+                f"no *_errors validator reads cfg.{field} and the flag "
+                f"carries no choices/validating type: a bad value becomes "
+                f"a mid-run traceback instead of a named exit-2 rejection"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# FAULT-SITE
+# --------------------------------------------------------------------------
+
+def _injector_receiver(func: ast.AST) -> bool:
+    if not isinstance(func, ast.Attribute):
+        return False
+    recv = astutil.dotted(func.value)
+    if not recv:
+        return False
+    seg = astutil.last_segment(recv).lower()
+    return any(h in seg for h in _INJECTOR_HINTS)
+
+
+def check_fault_site(path: str, tree: ast.AST,
+                     registry: ContractRegistry) -> List[Finding]:
+    """FAULT-SITE: every dotted site string handed to an injector-shaped
+    receiver's check/corrupt/armed is registered; corrupt requires
+    CORRUPT_SITES membership. Disarmed without a site registry."""
+    if not registry.sites_seen:
+        return []
+    if os.path.basename(path) == "faults.py":
+        return []  # the registry definition site itself
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("check", "corrupt", "armed")
+                and _injector_receiver(node.func) and node.args):
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+                and "." in arg.value):
+            continue
+        site = arg.value
+        if site not in registry.sites:
+            findings.append(Finding(
+                path, node.lineno, "FAULT-SITE", Severity.ERROR,
+                f"fault site '{site}' is not registered in "
+                f"robust.faults.SITES: the spec parser rejects it, so no "
+                f"chaos run can ever arm this injection point — register "
+                f"it or fix the typo"))
+        elif node.func.attr == "corrupt" \
+                and site not in registry.corrupt_sites:
+            findings.append(Finding(
+                path, node.lineno, "FAULT-SITE", Severity.ERROR,
+                f"fault site '{site}' is used with corrupt() but is not "
+                f"in robust.faults.CORRUPT_SITES: only sites owning a "
+                f"host payload may scramble one (docs/FAULTS.md) — "
+                f"register it corrupt-capable or drop the call"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# DRIVER-REG
+# --------------------------------------------------------------------------
+
+def _steppable_use(tree: ast.AST) -> Optional[int]:
+    lines = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if any(a.name in _STEPPABLE_NAMES for a in node.names):
+                lines.append(node.lineno)
+        elif isinstance(node, ast.Attribute) \
+                and node.attr in _STEPPABLE_NAMES:
+            lines.append(node.lineno)
+    return min(lines) if lines else None
+
+
+def _jit_use(tree: ast.AST) -> Optional[int]:
+    lines = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and astutil.is_jit_call(node):
+            lines.append(node.lineno)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if ((isinstance(dec, ast.Call) and astutil.is_jit_call(dec))
+                        or astutil.dotted(dec) in ("jax.jit", "jit")):
+                    lines.append(dec.lineno)
+    return min(lines) if lines else None
+
+
+def _find_check_sh(path: str) -> Optional[str]:
+    """scripts/check.sh located by walking up from the scanned file."""
+    d = os.path.dirname(astutil.normalize_path(path))
+    for _ in range(6):
+        cand = os.path.join(d, "scripts", "check.sh")
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+def check_driver_reg(path: str, tree: ast.AST) -> List[Finding]:
+    """DRIVER-REG, per-module half: a fira_tpu module that dispatches
+    jitted programs or drives engine/fleet steppables must be a
+    designated driver module."""
+    from fira_tpu.analysis.rules_purity import _package_relative
+
+    rel = _package_relative(astutil.normalize_path(path))
+    if rel is None or not rel or rel.startswith("analysis/") \
+            or os.path.basename(path) == "__init__.py":
+        return []
+    if astutil.is_driver_module(path):
+        return []
+    findings: List[Finding] = []
+    line = _steppable_use(tree)
+    if line is not None:
+        findings.append(Finding(
+            path, line, "DRIVER-REG", Severity.ERROR,
+            f"module drives the engine/fleet steppables but is not in "
+            f"analysis.astutil._DRIVER_FILES: its scheduling loops are "
+            f"invisible to the hot-region/concurrency rules — register "
+            f"it (and name it in scripts/check.sh) or waive with a "
+            f"reason"))
+        return findings
+    line = _jit_use(tree)
+    if line is not None:
+        findings.append(Finding(
+            path, line, "DRIVER-REG", Severity.ERROR,
+            f"module constructs jitted programs (jax.jit) but is not in "
+            f"analysis.astutil._DRIVER_FILES: its dispatch loops are "
+            f"invisible to the hot-region/concurrency rules — register "
+            f"it (and name it in scripts/check.sh) or waive with a "
+            f"reason"))
+    return findings
+
+
+def check_driver_names(path: str, tree: ast.AST) -> List[Finding]:
+    """DRIVER-REG, registry half: runs only on the file that defines
+    _DRIVER_FILES (analysis/astutil.py) — every registered driver module
+    must be NAMED in scripts/check.sh so a check.sh refactor can never
+    silently drop one from the gate."""
+    entries = _module_tuple(tree, "_DRIVER_FILES")
+    if not entries:
+        return []
+    sh = _find_check_sh(path)
+    if sh is None:
+        return []  # no check.sh in this checkout: nothing to pin against
+    try:
+        with open(sh, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return []
+    findings: List[Finding] = []
+    for line, entry in entries:
+        if entry not in text:
+            findings.append(Finding(
+                path, line, "DRIVER-REG", Severity.ERROR,
+                f"driver module '{entry}' (_DRIVER_FILES) is not named in "
+                f"scripts/check.sh: the self-scan would silently lose it "
+                f"if the directory arguments ever change — name it in the "
+                f"check.sh invocation"))
+    return findings
+
+
+def check(path: str, tree: ast.AST, source: str, parents, spans, *,
+          registry: Optional[ContractRegistry] = None) -> List[Finding]:
+    registry = registry if registry is not None else ContractRegistry()
+    findings: List[Finding] = []
+    findings += check_knob_validate(path, tree, parents, registry)
+    findings += check_fault_site(path, tree, registry)
+    findings += check_driver_reg(path, tree)
+    findings += check_driver_names(path, tree)
+    return findings

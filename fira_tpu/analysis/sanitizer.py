@@ -1,6 +1,6 @@
 """Runtime sanitizer: the dynamic twin of firacheck's static rules.
 
-``--sanitize`` on the train/test CLIs arms three checks for the whole run:
+``--sanitize`` on the train/test CLIs arms four checks for the whole run:
 
 - ``jax_debug_nans`` / ``jax_debug_infs``: every jitted program is
   re-checked for non-finite outputs (JAX re-runs op-by-op on a hit, so the
@@ -13,6 +13,18 @@
   after each dispatch of a program; a label's FIRST step may compile
   (warmup), any compilation attributed to a later step of a known label
   raises :class:`RetraceError` with the captured program names.
+- :class:`ThreadGuard`: the lock-discipline sanitizer (static twin:
+  SHARED-MUT). While armed, the threaded shared structures — the ingest
+  result cache / lex+hunk memos (ingest/cache.py), the fault injector's
+  fired accounting (robust/faults.py), and the feeder's ordered-ready
+  channel (data/feeder.py) — are constructed as GUARDED proxies: a
+  mutation by a thread that does not hold the structure's owning lock
+  raises :class:`LockDisciplineError` at the mutating line, and every
+  lock acquisition records its ordering edges so an inversion (A→B
+  observed after B→A) is flagged in ``ThreadGuard.inversions``.
+  Unarmed, nothing is wrapped: the structures are plain dicts/Counters
+  and the only cost is one is-None branch at construction — the
+  CompileGuard zero-overhead discipline.
 
 The guard is deliberately per-label, not global: a fused-steps run
 legitimately compiles the grouped program at step 1 and the per-step
@@ -25,7 +37,8 @@ import collections
 import contextlib
 import dataclasses
 import logging
-from typing import Dict, Iterator, Optional
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
 
 _COMPILE_LOGGERS = (
     "jax._src.interpreters.pxla",  # "Compiling <fn> with global shapes..."
@@ -36,6 +49,11 @@ _COMPILE_PREFIXES = ("Compiling ",)
 
 class RetraceError(RuntimeError):
     """A post-warmup step triggered a fresh XLA compilation."""
+
+
+class LockDisciplineError(RuntimeError):
+    """A guarded shared structure was mutated by a thread that does not
+    hold its owning lock (ThreadGuard; static twin: SHARED-MUT)."""
 
 
 def program_label(kind: str, tag: Optional[str] = None, group: int = 1) -> str:
@@ -157,6 +175,276 @@ class CompileGuard:
         return self._extra
 
 
+# --------------------------------------------------------------------------
+# ThreadGuard: the runtime lock-discipline sanitizer (static twin:
+# SHARED-MUT / rules_concurrency.py)
+# --------------------------------------------------------------------------
+
+class _GuardedLock:
+    """A lock (or Condition) wrapper that records held-set membership in
+    the owning ThreadGuard's thread-local state and lock-order edges on
+    every acquisition. All other attributes (``wait``, ``notify_all``,
+    ...) pass through, so a Condition keeps working as a Condition."""
+
+    def __init__(self, guard: "ThreadGuard", lock, name: str):
+        self._tg_guard = guard
+        self._tg_lock = lock
+        self.name = name
+
+    def acquire(self, *args, **kwargs):
+        got = self._tg_lock.acquire(*args, **kwargs)
+        if got:
+            self._tg_guard._note_acquire(self.name)
+        return got
+
+    def release(self):
+        self._tg_guard._note_release(self.name)
+        self._tg_lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __getattr__(self, attr):
+        # Condition.wait/notify/notify_all etc. pass through; wait()
+        # releases and reacquires the UNDERLYING lock internally — the
+        # held-set entry stays put, which is correct: from this thread's
+        # point of view the critical section never closed
+        return getattr(self._tg_lock, attr)
+
+
+class _GuardedMutations:
+    """The ONE copy of the mutation-check machinery the guarded
+    containers mix in (before their base in the MRO, so ``super()``
+    resolves to the real container). Reads are unchecked — the
+    sanitizer targets unsynchronized WRITES, the SHARED-MUT bug class.
+    During base-class ``__init__`` (which may call ``update``/
+    ``__setitem__``) the class-level ``_tg_guard = None`` default makes
+    every check a no-op; ThreadGuard.wrap binds the instance attrs
+    afterwards."""
+
+    _tg_guard: "ThreadGuard" = None  # set by ThreadGuard.wrap
+    _tg_lock: str = ""
+    _tg_label: str = ""
+
+    def _tg_check(self):
+        if self._tg_guard is not None:
+            self._tg_guard._check_mutation(self._tg_lock, self._tg_label)
+
+    def __setitem__(self, k, v):
+        self._tg_check()
+        super().__setitem__(k, v)
+
+    def __delitem__(self, k):
+        self._tg_check()
+        super().__delitem__(k)
+
+    def pop(self, *a, **kw):
+        self._tg_check()
+        return super().pop(*a, **kw)
+
+    def popitem(self, *a, **kw):
+        self._tg_check()
+        return super().popitem(*a, **kw)
+
+    def clear(self):
+        self._tg_check()
+        super().clear()
+
+    def update(self, *a, **kw):
+        self._tg_check()
+        super().update(*a, **kw)
+
+    def setdefault(self, *a, **kw):
+        self._tg_check()
+        return super().setdefault(*a, **kw)
+
+
+class _GuardedDict(_GuardedMutations, collections.OrderedDict):
+    """Mutation-checked mapping proxy (order-preserving, so it stands in
+    for both plain dicts and OrderedDicts)."""
+
+    def move_to_end(self, *a, **kw):
+        self._tg_check()
+        super().move_to_end(*a, **kw)
+
+
+class _GuardedCounter(_GuardedMutations, collections.Counter):
+    """Mutation-checked Counter (``c[k] += 1`` routes through
+    ``__setitem__``, exactly the unlocked-increment bug class)."""
+
+    def subtract(self, *a, **kw):
+        self._tg_check()
+        super().subtract(*a, **kw)
+
+
+class ThreadGuard:
+    """Runtime lock-discipline sanitizer (docs/ANALYSIS.md "Runtime
+    sanitizer"): declared shared structures mutate only under their
+    owning lock, and lock-acquisition order is recorded to flag
+    inversions.
+
+    Usage (the pattern ingest/cache.py, robust/faults.py and
+    data/feeder.py follow)::
+
+        tg = thread_guard()           # None when unarmed
+        if tg is not None:
+            self._lock = tg.lock(self._lock, "IngestCache._lock")
+            self._lru = tg.wrap(self._lru, self._lock, "IngestCache._lru")
+
+    A ``wrap``-ped structure raises :class:`LockDisciplineError` on any
+    mutation by a thread not currently holding the named lock. ``lock``
+    additionally records ordering edges: whenever B is acquired while A
+    is held the edge A→B is added, and if B→A was ever observed the
+    inversion is recorded in :attr:`inversions` (recorded, not raised —
+    a single observed inversion is a deadlock PRECONDITION, and the
+    post-mortem wants the full pair list, not the first half of it).
+    """
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._meta = threading.Lock()   # guards the order/violation books
+        self._edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self.inversions: List[Dict] = []
+        self.violations: List[Dict] = []
+
+    # --- held-set bookkeeping (per thread) ---
+
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _note_acquire(self, name: str) -> None:
+        held = self._held()
+        if held:
+            with self._meta:
+                for h in held:
+                    if h == name:
+                        continue
+                    edge = (h, name)
+                    if edge not in self._edges:
+                        self._edges[edge] = (threading.current_thread().name,
+                                             "")
+                        if (name, h) in self._edges:
+                            self.inversions.append({
+                                "first": f"{name} -> {h}",
+                                "then": f"{h} -> {name}",
+                                "thread": threading.current_thread().name,
+                            })
+        held.append(name)
+
+    def _note_release(self, name: str) -> None:
+        held = self._held()
+        # remove the LAST occurrence: locks nest, releases unwind
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                break
+
+    def _check_mutation(self, lock_name: str, label: str) -> None:
+        held = self._held()
+        if lock_name in held:
+            return
+        record = {"structure": label, "lock": lock_name,
+                  "thread": threading.current_thread().name,
+                  "held": list(held)}
+        with self._meta:
+            self.violations.append(record)
+        raise LockDisciplineError(
+            f"sanitizer: `{label}` mutated without holding its owning "
+            f"lock `{lock_name}` (thread {record['thread']}, held locks: "
+            f"{record['held'] or 'none'}) — the SHARED-MUT discipline: "
+            f"every write site takes the lock, or the lock protects "
+            f"nothing")
+
+    # --- declaration surface ---
+
+    def lock(self, lock, name: str) -> _GuardedLock:
+        """Wrap a threading.Lock/RLock/Condition so acquisitions are
+        tracked. ``name`` should be unique per instance (the callers
+        suffix ``@{id(self):x}``)."""
+        return _GuardedLock(self, lock, name)
+
+    def wrap(self, obj, lock, label: str):
+        """Wrap a shared structure so mutations require holding ``lock``
+        (a :meth:`lock`-wrapped GuardedLock, or its name). Supports the
+        mapping/Counter shapes the armed structures actually are;
+        anything else is returned unwrapped (never break a run over an
+        unguardable type)."""
+        lock_name = lock.name if isinstance(lock, _GuardedLock) else str(lock)
+        if isinstance(obj, collections.Counter):
+            new: object = _GuardedCounter(obj)
+        elif isinstance(obj, dict):
+            new = _GuardedDict(obj)
+        else:
+            return obj
+        new._tg_guard = self
+        new._tg_lock = lock_name
+        new._tg_label = label
+        return new
+
+    def summary(self) -> Dict:
+        with self._meta:
+            return {"violations": len(self.violations),
+                    "lock_order_edges": len(self._edges),
+                    "inversions": list(self.inversions)}
+
+
+# process-global arming point: the threaded structures are constructed
+# deep inside worker machinery, so they look the guard up here instead
+# of threading it through every constructor. None = unarmed = nothing
+# is ever wrapped (the zero-overhead contract).
+_THREAD_GUARD: Optional[ThreadGuard] = None
+
+
+def thread_guard() -> Optional[ThreadGuard]:
+    """The armed ThreadGuard, or None. Called at construction time by
+    the guarded classes (IngestCache, FaultInjector, Feeder)."""
+    return _THREAD_GUARD
+
+
+def guard_structures(owner, lock, structures, lock_label: str = "_lock"):
+    """Construction-time arming hook for the guarded classes
+    (IngestCache/LexMemo/HunkMemo, FaultInjector, Feeder): returns
+    ``(lock, [structures...])`` untouched when no ThreadGuard is armed
+    (one is-None branch, zero steady-state overhead), else the guarded
+    lock plus mutation-checked proxies. ``structures`` is a list of
+    ``(structure, label)`` pairs; ``lock_label`` is the owner's REAL
+    attribute name for the lock (Feeder's is ``_cond``) so a violation
+    message points at an attribute that exists; names are suffixed
+    ``@id`` so two instances never alias each other's held-lock
+    authority."""
+    tg = thread_guard()
+    if tg is None:
+        return lock, [s for s, _label in structures]
+    name = f"{type(owner).__name__}.{lock_label}@{id(owner):x}"
+    glock = tg.lock(lock, name)
+    return glock, [tg.wrap(s, glock,
+                           f"{type(owner).__name__}.{label}@{id(owner):x}")
+                   for s, label in structures]
+
+
+@contextlib.contextmanager
+def thread_guarding(guard: Optional[ThreadGuard] = None
+                    ) -> Iterator[ThreadGuard]:
+    """Arm a ThreadGuard for the block (tests; jax-free — this touches
+    no jax config). Structures constructed INSIDE the block are guarded;
+    pre-existing ones are not (arming is a construction-time choice)."""
+    global _THREAD_GUARD
+    prev = _THREAD_GUARD
+    tg = guard if guard is not None else ThreadGuard()
+    _THREAD_GUARD = tg
+    try:
+        yield tg
+    finally:
+        _THREAD_GUARD = prev
+
+
 @contextlib.contextmanager
 def compile_capture() -> Iterator[CompileWatcher]:
     """Arm jax_log_compiles and attach the counting handler; restores
@@ -204,6 +492,12 @@ def arm(enabled: bool = True, *, nans: bool = True, infs: bool = True,
         lg.addHandler(watcher)
         if lg.getEffectiveLevel() > logging.WARNING:
             lg.setLevel(logging.WARNING)
+    # lock-discipline sanitizer: process-lifetime arming like the rest of
+    # this function — threaded shared structures constructed from here on
+    # are guarded proxies (docstring above; thread_guarding() is the
+    # scoped alternative for library callers/tests)
+    global _THREAD_GUARD
+    _THREAD_GUARD = ThreadGuard()
     return CompileGuard(watcher)
 
 
@@ -226,7 +520,7 @@ def sanitize(enabled: bool = True, *, nans: bool = True, infs: bool = True,
     jax.config.update("jax_debug_nans", nans)
     jax.config.update("jax_debug_infs", infs)
     try:
-        with compile_capture() as watcher:
+        with compile_capture() as watcher, thread_guarding():
             yield CompileGuard(watcher)
     finally:
         jax.config.update("jax_debug_nans", prev_nans)

@@ -683,8 +683,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     from fira_tpu.parallel import mesh as pmesh
 
     mesh = _make_mesh(args.mesh) if args.command == "train" else None
-    errs = list(pmesh.divisibility_errors(
-        cfg, mesh.shape[pmesh.DATA_AXIS] if mesh is not None else 1))
+    # core train-knob admission (epochs, fused/accum device-loop axes,
+    # ring seq shards) — same exit-2 contract, config.config_errors
+    from fira_tpu.config import config_errors
+
+    errs = list(config_errors(cfg))
+    errs += pmesh.divisibility_errors(
+        cfg, mesh.shape[pmesh.DATA_AXIS] if mesh is not None else 1)
     if cfg.decode_engine:
         from fira_tpu.parallel.fleet import fleet_divisibility_errors
 

@@ -595,6 +595,37 @@ def get_config(name: str, **kw) -> FiraConfig:
     return NAMED_CONFIGS[name](**kw)
 
 
+def config_errors(cfg: FiraConfig) -> list:
+    """Parse-time admission for the core train-loop knobs the CLI
+    exposes with bare integer flags (--epochs/--fused-steps/
+    --accum-steps/--seq-shards): one named-knob message per violation,
+    CLI exit 2 — the same contract as mesh.divisibility_errors /
+    serve.server.serve_errors, enforced for every CLI-writable knob by
+    the firacheck KNOB-VALIDATE lint (docs/ANALYSIS.md)."""
+    errs = []
+    if cfg.epochs < 1:
+        errs.append(f"epochs {cfg.epochs} must be >= 1")
+    if cfg.fused_steps < 1:
+        errs.append(
+            f"fused_steps {cfg.fused_steps} must be >= 1 (1 = per-step "
+            f"dispatch; K > 1 runs K steps per dispatch as one device "
+            f"loop)")
+    if cfg.accum_steps < 1:
+        errs.append(
+            f"accum_steps {cfg.accum_steps} must be >= 1 (1 = no "
+            f"gradient accumulation)")
+    if cfg.fused_steps > 1 and cfg.accum_steps > 1:
+        errs.append(
+            f"fused_steps {cfg.fused_steps} and accum_steps "
+            f"{cfg.accum_steps} are mutually exclusive (one device-loop "
+            f"axis per dispatch); set one of them to 1")
+    if cfg.seq_shards < 0:
+        errs.append(
+            f"seq_shards {cfg.seq_shards} must be >= 0 (0/1 = dense "
+            f"cross-attention, N > 1 ring-shards K/V over N devices)")
+    return errs
+
+
 def apply_ablation(cfg: FiraConfig, ablation: Optional[str]) -> FiraConfig:
     """Map the paper's ablation names onto config switches.
 

@@ -163,6 +163,15 @@ class Feeder:
 
         self._cond = threading.Condition()
         self._ready: Dict[int, FedBatch] = {}
+        # lock-discipline sanitizer (--sanitize / tests): the ordered-
+        # ready channel is the one structure every worker AND the
+        # consumer mutate — armed, a write outside `with self._cond`
+        # raises at the line (analysis.sanitizer.ThreadGuard)
+        from fira_tpu.analysis.sanitizer import guard_structures
+
+        self._cond, (self._ready,) = guard_structures(
+            self, self._cond, [(self._ready, "_ready")],
+            lock_label="_cond")
         self._error: Optional[BaseException] = None
         self._total: Optional[int] = None   # set when tasks exhaust
         self._stop = threading.Event()
@@ -249,13 +258,13 @@ class Feeder:
                 if attempt < self._retries:
                     attempt += 1
                     if self._retry_backoff_s is not None:
-                        time.sleep(self._retry_backoff_s * attempt)
+                        time.sleep(self._retry_backoff_s * attempt)  # firacheck: allow[SCHED-BLOCK] worker-side quarantine retry backoff: the WORKER thread is the right place to sleep — siblings keep assembling and the consumer only ever waits on the ordered-ready condition
                     else:
                         # the shared quarantine backoff curve — one
                         # definition for every retry site (docs/FAULTS.md)
                         from fira_tpu.robust.faults import backoff_s
 
-                        time.sleep(backoff_s(attempt))
+                        time.sleep(backoff_s(attempt))  # firacheck: allow[SCHED-BLOCK] same worker-side retry backoff as above (the shared docs/FAULTS.md curve)
                     continue
                 err = FeederTaskError(seq, getattr(task, "note", None), e)
                 if self._on_error == "record":
@@ -306,7 +315,7 @@ class Feeder:
                 if self._total is not None and self._next >= self._total:
                     err = StopIteration()
                     break
-                self._cond.wait()
+                self._cond.wait()  # firacheck: allow[SCHED-BLOCK] this wait IS the metered feed stall (stall_s): the consumer blocks exactly until the next in-order item, and close()/_poison notify_all so it can never wedge
         if err is not None:
             self.close()
             raise err

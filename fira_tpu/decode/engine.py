@@ -721,6 +721,7 @@ class SlotEngine:
         else:
             st = chunk["states"]
             z["states"] = np.zeros((S * K,) + st.shape[1:], st.dtype)
+        # firacheck: allow[RETIRED-RECHECK] arena-state write: retire() deliberately leaves the arena in place ("the arena and stats stay") and a dead engine's _state is never read again — only scheduling/guard state needs the post-dispatch re-check
         self._state = jax.device_put(z, self.device)
 
     # --- host scheduler --------------------------------------------------
@@ -1055,6 +1056,7 @@ class SlotEngine:
         # the insert sees the exact pytree the prefill would have produced)
         chunk = None
         payloads: Dict[int, Dict] = {}
+        pending_fill = None
         st = self.stats
         if seat_rows and self._cache is not None and all(
                 self._cache.contains(digests[r]) for r, _p in seat_rows):
@@ -1106,7 +1108,11 @@ class SlotEngine:
                         a = chunk[f]
                         if hasattr(a, "copy_to_host_async"):
                             a.copy_to_host_async()
-                    self._pending_fills.append((fills, chunk))
+                    # committed below with the other shared maps: retire()
+                    # clears _pending_fills ("a dead replica fills no
+                    # cache"), and an abandoned thread appending after
+                    # that clear would resurrect a fill on a dead engine
+                    pending_fill = (fills, chunk)
 
         # COMMIT — maps and staging mutate only on a fully-successful
         # path, and only on a LIVE engine: the cache-hit branch above
@@ -1117,6 +1123,8 @@ class SlotEngine:
         # guards)
         if self.retired:
             return
+        if pending_fill is not None:
+            self._pending_fills.append(pending_fill)
         if followers:
             for leader, pos_id, r in followers:
                 self._followers.setdefault(leader, []).append(
@@ -1180,8 +1188,16 @@ class SlotEngine:
                     self._slot_blocks[slot] = grant
                 self._busy[slot] = (pos_id, entry.host, r)
                 n_ins += 1
-            self._state = self._insert(self._state, entry.chunk, slot_ids,
-                                       limits, block_rows)
+            new_state = self._insert(self._state, entry.chunk, slot_ids,
+                                     limits, block_rows)
+            if self.retired:
+                # the watchdog expired while the insert dispatch ran and
+                # the replica was retired: retire() already requeued
+                # every owed row — the live loop owns the guard, stats,
+                # and staging state now; this abandoned thread must not
+                # touch them (RETIRED-RECHECK discipline)
+                return
+            self._state = new_state
             self._guard_step(self.label(INSERT_LABEL))
             self.stats.refills += 1
             self.stats.slots_refilled += n_ins
@@ -1273,11 +1289,15 @@ class SlotEngine:
                     return []  # abandoned by a watchdog mid-harvest
                 toks_s, probs_s = self._take_rows(tokens, probs,
                                                   jnp.int32(s))
+                toks_np = np.array(jax.device_get(toks_s))  # firacheck: allow[HOST-SYNC] harvest IS the engine's designated output boundary: settled beams must reach the host to be cooked into text, and the sliced row gather is exactly the copy this readback exists to make
+                probs_np = np.array(jax.device_get(probs_s))  # firacheck: allow[HOST-SYNC] same harvest output boundary as the line above
+                if self.retired:
+                    # the gather/readback above is exactly the window a
+                    # watchdog expiry abandons this thread inside: the
+                    # live loop owns the shared compile guard now
+                    return []
                 self._guard_step(self.label(HARVEST_LABEL))
-                reads.append((
-                    s,
-                    np.array(jax.device_get(toks_s)),  # firacheck: allow[HOST-SYNC] harvest IS the engine's designated output boundary: settled beams must reach the host to be cooked into text, and the sliced row gather is exactly the copy this readback exists to make
-                    np.array(jax.device_get(probs_s))))  # firacheck: allow[HOST-SYNC] same harvest output boundary as the line above
+                reads.append((s, toks_np, probs_np))
             if self.retired:
                 return []
             # PHASE 2 — every readback landed: retire the bookkeeping

@@ -84,6 +84,19 @@ HUNK_MEMO_ENTRIES = 4096
 LEX_MEMO_ENTRIES = 8192
 
 
+def _arm_thread_guard(owner, lock, structures):
+    """Lock-discipline sanitizer hook (analysis.sanitizer
+    .guard_structures; docs/ANALYSIS.md "Runtime sanitizer"): when a
+    ThreadGuard is armed, a mutation of the wrapped structures without
+    the owning lock raises at the mutating line; unarmed, the inputs
+    come back untouched. The import is LAZY and the sanitizer pulls no
+    JAX at module level, so this module stays a safe spawn entry for
+    the process pool children (which construct their own memos)."""
+    from fira_tpu.analysis.sanitizer import guard_structures
+
+    return guard_structures(owner, lock, structures)
+
+
 def text_digest(text: str) -> str:
     """Content address of one raw request: keyed blake2b over the diff
     text bytes — computed at intake, BEFORE any lexing."""
@@ -168,6 +181,12 @@ class IngestCache:
         self.fault_misses = 0
         self.integrity_drops = 0
         self.evictions = 0
+        # lock-discipline sanitizer (--sanitize / tests): the LRU and the
+        # in-flight leadership map are mutated from every feeder worker —
+        # armed, a mutation outside `with self._lock` raises at the line
+        self._lock, (self._lru, self._pending) = _arm_thread_guard(
+            self, self._lock, [(self._lru, "_lru"),
+                               (self._pending, "_pending")])
 
     def _integrity(self) -> bool:
         return self._faults is not None and self._faults.armed(
@@ -352,6 +371,8 @@ class LexMemo:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self._lock, (self._lru,) = _arm_thread_guard(
+            self, self._lock, [(self._lru, "_lru")])
 
     def __call__(self, text: str):
         with self._lock:
@@ -391,6 +412,8 @@ class HunkMemo:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self._lock, (self._lru,) = _arm_thread_guard(
+            self, self._lock, [(self._lru, "_lru")])
 
     @staticmethod
     def _key(chunk, typ: int) -> str:

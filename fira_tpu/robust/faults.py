@@ -207,6 +207,13 @@ class FaultInjector:
         # fired accounting is mutated from concurrent feeder workers —
         # Counter += is a non-atomic read-modify-write
         self._lock = threading.Lock()
+        # lock-discipline sanitizer (--sanitize / tests): exactly the
+        # unlocked-increment bug the PR 9 review caught here — armed, a
+        # `fired[site] += 1` outside `with self._lock` raises at the line
+        from fira_tpu.analysis.sanitizer import guard_structures
+
+        self._lock, (self.fired,) = guard_structures(
+            self, self._lock, [(self.fired, "fired")])
 
     def _record_fire(self, site: str, key) -> None:
         with self._lock:

@@ -366,7 +366,7 @@ class RecoveryManager:
         s = self._slot_of(eng)
         s.alive = False
         s.retired_round = int(round_)
-        s.retired_wall = time.monotonic()
+        s.retired_wall = time.monotonic()  # firacheck: allow[WALL-CLOCK] the respawn backoff is wall-gated BY DESIGN on wall-clock serves (crash-looping hardware backs off in real seconds); virtual replays gate on rounds instead (due() round branch), so no wall time reaches the virtual schedule
         s.last_error = error
 
     def can_recover(self) -> bool:
@@ -393,10 +393,10 @@ class RecoveryManager:
                 # are step dispatches and FREEZE during a total outage
                 # (the serve pause branch), so a round gate could never
                 # elapse there
+                age = time.monotonic() - s.retired_wall  # firacheck: allow[WALL-CLOCK] wall-gate branch runs ONLY under self.wall_clock (the wall-serve mode); the virtual-clock path below gates on rounds, so replay determinism is untouched
                 if (s.retired_wall >= 0
-                        and time.monotonic() - s.retired_wall
-                        < respawn_backoff_s(s.respawns + 1,
-                                            self.backoff_base)):
+                        and age < respawn_backoff_s(s.respawns + 1,
+                                                    self.backoff_base)):
                     continue
             else:
                 wait = min(s.respawns + 1, _BACKOFF_CAP_ATTEMPTS)
@@ -417,7 +417,7 @@ class RecoveryManager:
                                                       slot.device)
         except Exception as e:
             slot.retired_round = int(round_)   # backoff restarts
-            slot.retired_wall = time.monotonic()
+            slot.retired_wall = time.monotonic()  # firacheck: allow[WALL-CLOCK] same wall-gated respawn backoff stamp as note_retirement (round-gated on virtual replays)
             slot.last_error = f"respawn failed: {type(e).__name__}: {e}"
             return None, False
         slot.alive = True
@@ -435,7 +435,7 @@ class RecoveryManager:
         for o in sorted(self.slots):
             s = self.slots[o]
             while not s.alive and s.respawns < self.max_respawns:
-                time.sleep(respawn_backoff_s(s.respawns + 1,
+                time.sleep(respawn_backoff_s(s.respawns + 1,  # firacheck: allow[SCHED-BLOCK] drain-mode heal: single-threaded batch work with no open-loop arrivals to starve (docstring above); the serve loop's _heal never sleeps — it gates in due()
                                              self.backoff_base))
                 eng, _sp = self.respawn(s, s.retired_round)
                 if eng is not None:

@@ -1076,7 +1076,7 @@ class ServeLoop:
     # --- the loop -------------------------------------------------------
 
     def run(self) -> ServeStats:
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # firacheck: allow[WALL-CLOCK] ServeStats.wall_s is DEFINED as real elapsed seconds (the stall-fraction denominator must be wall over wall — PR 11 fourth-pass review); it never feeds the scheduling clock
         n = len(self._times)
         for eng in self.engines:
             # fresh host scheduling state per request stream (a no-op on
@@ -1111,7 +1111,7 @@ class ServeLoop:
                         # DISPATCHES — nothing dispatches, so the
                         # deadline clock must not inflate with spin
                         # iterations; just wait a beat
-                        time.sleep(0.01)
+                        time.sleep(0.01)  # firacheck: allow[SCHED-BLOCK] bounded 10ms beat on the ALL-REPLICAS-LOST pause branch: nothing can dispatch, arrivals are polled each beat, and the alternative is a busy-spin (PR 12 review)
                     else:
                         # virtual replay: the round clock IS the backoff
                         # gate — tick it deterministically
@@ -1233,7 +1233,7 @@ class ServeLoop:
                     and self.stats.rounds % SNAPSHOT_EVERY_ROUNDS == 0):
                 self._snapshot(self)
         self._flush_shed_log()   # sheds recorded after the last harvest
-        self.stats.wall_s = time.perf_counter() - t0
+        self.stats.wall_s = time.perf_counter() - t0  # firacheck: allow[WALL-CLOCK] the wall_s meter's closing read — same real-wall stall-denominator contract as the t0 stamp above
         return self.stats
 
 
@@ -1453,7 +1453,7 @@ def write_metrics_atomic(path: str, payload: Dict) -> str:
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=1, allow_nan=False)
         f.flush()
-        os.fsync(f.fileno())
+        os.fsync(f.fileno())  # firacheck: allow[SCHED-BLOCK] the atomic-artifact crash contract REQUIRES the fsync before the rename (docs/FAULTS.md); it runs once per snapshot cadence (16 rounds), not per dispatch, and the cost is metered in the journal-overhead rows
     os.replace(tmp, path)
     return path
 

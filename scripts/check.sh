@@ -8,6 +8,10 @@ set -u
 cd "$(dirname "$0")/.."
 
 echo "== firacheck: static JAX-hazard scan =="
+# EVERY designated driver module (astutil._DRIVER_FILES) is named
+# explicitly here (as well as being inside the fira_tpu tree, which the
+# CLI dedupes): fira_tpu/train/loop.py, fira_tpu/train/step.py,
+# fira_tpu/decode/runner.py, fira_tpu/decode/beam.py,
 # fira_tpu/data/feeder.py, fira_tpu/data/buckets.py,
 # fira_tpu/data/grouping.py, fira_tpu/decode/engine.py,
 # fira_tpu/decode/paging.py, fira_tpu/decode/prefix_cache.py,
@@ -15,20 +19,22 @@ echo "== firacheck: static JAX-hazard scan =="
 # fira_tpu/serve/server.py, fira_tpu/ingest/difftext.py,
 # fira_tpu/ingest/service.py, fira_tpu/ingest/cache.py,
 # fira_tpu/robust/faults.py,
-# fira_tpu/robust/watchdog.py and fira_tpu/robust/recovery.py are named
-# explicitly (as well as being
-# inside the fira_tpu tree, which the CLI dedupes): the async input
-# pipeline, the bucket packer, the grouped dispatch scheduler, the
-# slot-refill decode engine, the paged-KV arena geometry/validation, the
-# cross-request prefix cache, the replicated decode fleet, the
-# arrival-timed serving loop, the raw-diff ingest pipeline (+ its
-# whole-diff result cache / hunk memo / process executor) and the
-# fault-injection/watchdog/recovery machinery
-# are designated driver modules (astutil._DRIVER_FILES) whose
+# fira_tpu/robust/watchdog.py and fira_tpu/robust/recovery.py — the
+# train loop/step factories, the beam/engine decode drivers, the async
+# input pipeline, the bucket packer, the grouped dispatch scheduler,
+# the slot-refill decode engine, the paged-KV arena
+# geometry/validation, the cross-request prefix cache, the replicated
+# decode fleet, the arrival-timed serving loop, the raw-diff ingest
+# pipeline (+ its whole-diff result cache / hunk memo / process
+# executor) and the fault-injection/watchdog/recovery machinery. Their
 # threaded/packing/refill/admission loops MUST stay in the self-scan
-# even if the directory arguments ever change.
+# even if the directory arguments ever change — the DRIVER-REG lint
+# gates on exactly this list naming every _DRIVER_FILES entry.
 JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
-    fira_tpu fira_tpu/data/feeder.py fira_tpu/data/buckets.py \
+    fira_tpu \
+    fira_tpu/train/loop.py fira_tpu/train/step.py \
+    fira_tpu/decode/runner.py fira_tpu/decode/beam.py \
+    fira_tpu/data/feeder.py fira_tpu/data/buckets.py \
     fira_tpu/data/grouping.py fira_tpu/decode/engine.py \
     fira_tpu/decode/paging.py fira_tpu/decode/prefix_cache.py \
     fira_tpu/parallel/fleet.py \
@@ -38,6 +44,25 @@ JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu/robust/watchdog.py fira_tpu/robust/recovery.py \
     tests scripts \
     || exit $?
+
+echo "== firacheck v2: concurrency-race + serving-contract scan (docs/ANALYSIS.md) =="
+# The v2 rule families run as their OWN named leg with exit-code gating
+# and a machine-readable artifact: shared-state lock discipline
+# (SHARED-MUT), abandoned-watchdog re-checks (RETIRED-RECHECK),
+# scheduler-blocking primitives (SCHED-BLOCK), wall-clock leaks into
+# virtual replay (WALL-CLOCK), settle-order float accumulation
+# (FLOAT-ORDER), and the merge contracts: every CLI-writable knob
+# parse-time validated (KNOB-VALIDATE), every fault site registered
+# (FAULT-SITE), every jit/steppable driver module registered AND named
+# above (DRIVER-REG). The full scan above already gates on these too;
+# this leg pins the rule-family exit path and emits the JSON artifact
+# (per-rule counts + findings array) for CI consumption.
+FIRACHECK_JSON="${FIRACHECK_JSON:-/tmp/firacheck_v2_scan.json}"
+JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check --json \
+    --rules SHARED-MUT,RETIRED-RECHECK,SCHED-BLOCK,WALL-CLOCK,FLOAT-ORDER,KNOB-VALIDATE,FAULT-SITE,DRIVER-REG \
+    fira_tpu tests scripts \
+    > "$FIRACHECK_JSON" || { cat "$FIRACHECK_JSON"; exit 1; }
+echo "firacheck v2 artifact -> $FIRACHECK_JSON"
 
 echo "== multichip smoke: 2 virtual CPU devices (docs/MULTICHIP.md) =="
 # Mesh paths stay green in tier-1: one sharded grouped-train window plus

@@ -33,6 +33,7 @@ def run_scan(xs):
     return jax.lax.scan(scan_body, 0.0, xs)
 
 
+# firacheck: allow[DRIVER-REG] this corpus is a scanned-as-text test bed whose jit calls ARE the planted hazards — it never dispatches anything, so driver registration would be noise (the earliest jit use anchors the module-level finding here)
 @jax.jit
 def jitted_sync(x):
     return x.item()  # HAZARD[HOST-SYNC] .item() in a jitted function

@@ -1,0 +1,167 @@
+"""Planted-hazard corpus for the firacheck v2 concurrency/contract rules
+(tests/test_firacheck.py::test_v2_rules_fire_and_match_golden_markers).
+
+NEVER imported — scanned as text under a VIRTUAL DRIVER PATH ending in
+``fira_tpu/serve/server.py``, which arms the driver-scoped concurrency
+rules (SHARED-MUT / RETIRED-RECHECK / SCHED-BLOCK / FLOAT-ORDER) and the
+virtual-clock scope (WALL-CLOCK) while the real serve module stays
+untouched. Every line carrying ``HAZARD[RULE-ID]`` must produce exactly
+that finding; lines whose allow-reason says SILENCED must produce none.
+DRIVER-REG has its own cross-file tests (it keys off the REAL driver
+registry + scripts/check.sh, which a one-file corpus cannot carry).
+
+Directory walks skip ``fixtures/`` (engine.iter_py_files) — these
+hazards are live on purpose and must not dirty the repo self-scan.
+"""
+
+import threading
+import time
+
+
+# --- SHARED-MUT: lock-discipline drift on shared counters ----------------
+
+class LockedMeterHazard:
+    """One attribute written locked in one method, bare in another."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0  # control: __init__ precedes sharing
+
+    def hit(self):
+        with self._lock:
+            self.hits += 1  # control: the locked write site
+
+    def hit_unlocked(self):
+        self.hits += 1  # HAZARD[SHARED-MUT] bare write of a locked-elsewhere counter
+
+    def hit_waived(self):
+        # firacheck: allow[SHARED-MUT] SILENCED planted twin - single-threaded caller owns this path by contract
+        self.hits += 1
+
+
+class CrossThreadMeterHazard:
+    """One attribute mutated bare from a thread-entry method AND from a
+    scheduler-side method — no lock anywhere (the cross-count class)."""
+
+    def start(self):
+        self._t = threading.Thread(target=self._work)
+        self._t.start()
+
+    def _work(self):
+        self.count += 1  # HAZARD[SHARED-MUT] worker-thread write racing snapshot()
+
+    def snapshot(self):
+        self.count = 0  # the scheduler-side bare write it races
+
+
+# --- RETIRED-RECHECK: abandoned-watchdog mutation windows ----------------
+
+class SteppableHazard:
+    """Retire-capable steppable piece (the engine idiom)."""
+
+    def retire(self):
+        self.retired = True
+
+    def admit(self, batch):
+        chunk = self._prefill(batch)  # dispatch boundary
+        self._staged.append(chunk)  # HAZARD[RETIRED-RECHECK] no re-check after the dispatch
+
+    def admit_guarded(self, batch):
+        chunk = self._prefill(batch)
+        self._guard_step("prefill")  # HAZARD[RETIRED-RECHECK] shared compile guard touched unchecked
+
+    def admit_ok(self, batch):
+        chunk = self._prefill(batch)
+        if self.retired:
+            return  # control: the documented bail-early discipline
+        self._staged.append(chunk)
+
+    def admit_waived(self, batch):
+        chunk = self._prefill(batch)
+        # firacheck: allow[RETIRED-RECHECK] SILENCED planted twin - this piece is never dispatched under a watchdog by contract
+        self._staged.append(chunk)
+
+
+# --- SCHED-BLOCK: uncancellable blocking on the hot path -----------------
+
+def scheduler_round(queue, ev):
+    for item in queue:  # driver loop => hot region
+        time.sleep(0.01)  # HAZARD[SCHED-BLOCK] bare sleep on the scheduler hot path
+        ev.wait()  # HAZARD[SCHED-BLOCK] Event.wait without a timeout
+        ev.wait(0.5)  # control: bounded wait
+    return ev
+
+
+def scheduler_round_waived(queue, ev):
+    for item in queue:
+        # firacheck: allow[SCHED-BLOCK] SILENCED planted twin - sanctioned bounded beat on an outage path
+        time.sleep(0.01)
+    return ev
+
+
+def close(pool):
+    for t in pool:
+        t.join()  # control: lifecycle shutdown join is the contract
+
+
+# --- WALL-CLOCK: wall reads outside the *Clock classes -------------------
+
+def stamp_now():
+    return time.perf_counter()  # HAZARD[WALL-CLOCK] raw wall read in a make_clock module
+
+
+class FixtureClock:
+    def now(self):
+        return time.perf_counter()  # control: the *Clock boundary itself
+
+
+# --- FLOAT-ORDER: settle-order float accumulation ------------------------
+
+def aggregate(by_pos):
+    total = 0.0
+    for p in by_pos.values():
+        total += p  # HAZARD[FLOAT-ORDER] float sum in settle order
+    ordered = 0.0
+    for k in sorted(by_pos):
+        ordered += by_pos[k]  # control: sorted order reassociates identically
+    n = 0
+    for p in by_pos.values():
+        n += 1  # control: integer counting is order-safe
+    return total, ordered, n
+
+
+# --- KNOB-VALIDATE: a CLI-written knob with no parse-time validator ------
+
+def build_fixture_parser(p):
+    p.add_argument("--good-knob", type=int)
+    p.add_argument("--bad-knob", type=int)
+    p.add_argument("--choice-knob", choices=["a", "b"])
+    return p
+
+
+def fixture_knob_errors(cfg):
+    errs = []
+    if cfg.good_knob < 0:
+        errs.append("good_knob must be >= 0")
+    return errs
+
+
+def _resolve_cfg(args):
+    overrides = {}
+    if args.good_knob is not None:
+        overrides["good_knob"] = args.good_knob  # control: validator reads it
+    if args.choice_knob:
+        overrides["choice_knob"] = args.choice_knob  # control: choices constrain it
+    if args.bad_knob is not None:
+        overrides["bad_knob"] = args.bad_knob  # HAZARD[KNOB-VALIDATE] deliberately unvalidated knob
+    return overrides
+
+
+# --- FAULT-SITE: unregistered / corrupt-incapable site strings -----------
+
+class QuarantineHazard:
+    def admit(self, batch):
+        self._faults.check("serve.admit")  # control: registered site
+        self._faults.check("fixture.bogus")  # HAZARD[FAULT-SITE] deliberately unregistered fault site
+        batch = self._faults.corrupt("feeder.assemble", 0, batch)  # control: corrupt-capable
+        return self._faults.corrupt("engine.step", 0, batch)  # HAZARD[FAULT-SITE] corrupt on a dispatch-boundary site

@@ -119,3 +119,24 @@ def test_decode_is_batch_size_invariant(tmp_path):
     with open(os.path.join(out2, "output_fira")) as f:
         b = f.read()
     assert a == b
+
+
+def test_config_errors_gate_at_parse_time(tmp_path):
+    """The core train knobs (epochs / fused vs accum / seq_shards) are
+    parse-time validated with named messages, CLI exit 2 — the
+    KNOB-VALIDATE contract (config.config_errors): a bad value never
+    becomes a mid-run traceback."""
+    from fira_tpu.config import config_errors, fira_tiny
+
+    errs = config_errors(fira_tiny(epochs=0, seq_shards=-1))
+    assert any("epochs" in e for e in errs)
+    assert any("seq_shards" in e for e in errs)
+    errs = config_errors(fira_tiny(fused_steps=2, accum_steps=2))
+    assert any("mutually exclusive" in e for e in errs)
+    assert not config_errors(fira_tiny())
+    # end to end: exit 2 with the named knob (a falsy --epochs 0 never
+    # reaches cfg — the override block drops it — so the probe uses -1)
+    data = str(tmp_path / "DataSet")
+    rc = cli.main(["train", "--config", "fira-tiny", "--synthetic", "8",
+                   "--data-dir", data, "--epochs", "-1"])
+    assert rc == 2

@@ -27,15 +27,29 @@ from fira_tpu.analysis.findings import RULES, Severity
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "fixtures", "firacheck_hazards.py")
+FIXTURE_V2 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "fixtures", "firacheck_hazards_v2.py")
 # virtual path: arms the fira_tpu-scoped GEOMETRY-DRIFT rule while keeping
 # the hot-region logic identical (not a designated driver file)
 VIRTUAL_PATH = "fira_tpu/model/firacheck_hazards.py"
+# virtual DRIVER path for the v2 corpus: ends in a _DRIVER_FILES entry
+# that is also in the WALL-CLOCK module scope, so the driver-scoped
+# concurrency rules arm without touching the real serve module
+VIRTUAL_DRIVER_PATH = "virtual_fixture/fira_tpu/serve/server.py"
+
+# the v1 rule families (the v2 corpus owns the rest; DRIVER-REG keys off
+# the real registry + check.sh, so it has dedicated cross-file tests)
+V1_RULES = {"HOST-SYNC", "RETRACE", "DONATION", "PRNG-REUSE",
+            "DISCARDED-AT", "GEOMETRY-DRIFT"}
+V2_FIXTURE_RULES = {"SHARED-MUT", "RETIRED-RECHECK", "SCHED-BLOCK",
+                    "WALL-CLOCK", "FLOAT-ORDER", "KNOB-VALIDATE",
+                    "FAULT-SITE"}
 
 _MARKER = re.compile(r"HAZARD\[([A-Z-]+)\]")
 
 
-def _fixture_source():
-    with open(FIXTURE) as f:
+def _fixture_source(path=FIXTURE):
+    with open(path) as f:
         return f.read()
 
 
@@ -56,10 +70,66 @@ def test_every_rule_fires_and_matches_golden_markers():
     assert actual == expected, (
         f"unexpected: {sorted(actual - expected)}; "
         f"missing: {sorted(expected - actual)}")
-    # the fixture covers every shipped rule (BAD-SUPPRESS and PARSE-ERROR
-    # have their own dedicated tests below)
+    # the v1 fixture covers every v1 rule (BAD-SUPPRESS and PARSE-ERROR
+    # have their own dedicated tests below; the v2 families have their
+    # own corpus)
     fired = {rule for rule, _ in actual}
-    assert fired == set(RULES) - {"BAD-SUPPRESS", "PARSE-ERROR"}
+    assert fired == V1_RULES
+
+
+# distinctive message text per v2 rule: the finding must NAME the
+# discipline it enforces, pinned so a refactor can't silently blur it
+_V2_MESSAGE_PINS = {
+    "SHARED-MUT": ("written under a lock", "thread-entry path"),
+    "RETIRED-RECHECK": ("without re-checking `self.retired`",),
+    "SCHED-BLOCK": ("blocks uncancellably",),
+    "WALL-CLOCK": ("virtual-clock replay",),
+    "FLOAT-ORDER": ("float addition does not reassociate",),
+    "KNOB-VALIDATE": ("named exit-2 rejection",),
+    "FAULT-SITE": ("robust.faults.SITES", "CORRUPT_SITES"),
+}
+
+
+def test_v2_rules_fire_and_match_golden_markers():
+    source = _fixture_source(FIXTURE_V2)
+    expected = _expected_markers(source)
+    findings = engine.check_source(VIRTUAL_DRIVER_PATH, source)
+    actual = {(f.rule, f.line) for f in findings if f.rule != "BAD-SUPPRESS"}
+    assert actual == expected, (
+        f"unexpected: {sorted(actual - expected)}; "
+        f"missing: {sorted(expected - actual)}")
+    fired = {rule for rule, _ in actual}
+    assert fired == V2_FIXTURE_RULES
+    # exact message contracts: every pin phrase appears in some finding
+    # of its rule (SHARED-MUT/FAULT-SITE pin BOTH halves of their rule)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    for rule, pins in _V2_MESSAGE_PINS.items():
+        for pin in pins:
+            assert any(pin in m for m in by_rule.get(rule, [])), (
+                f"{rule}: no finding message contains {pin!r}")
+
+
+def test_v2_silenced_twins_are_suppressed_but_fire_raw():
+    source = _fixture_source(FIXTURE_V2)
+    silenced_lines = {
+        i + 1  # the standalone waiver targets the NEXT code line
+        for i, line in enumerate(source.splitlines(), start=1)
+        if "SILENCED" in line and "firacheck: allow[" in line
+    }
+    assert silenced_lines, "v2 fixture lost its SILENCED twins"
+    suppressed = engine.check_source(VIRTUAL_DRIVER_PATH, source)
+    raw = engine.check_source(VIRTUAL_DRIVER_PATH, source, suppress=False)
+    suppressed_lines = {f.line for f in suppressed
+                        if f.rule != "BAD-SUPPRESS"}
+    raw_lines = {f.line for f in raw if f.rule != "BAD-SUPPRESS"}
+    for line in silenced_lines:
+        assert line not in suppressed_lines, (
+            f"waiver on line {line - 1} did not silence its finding")
+        assert line in raw_lines, (
+            f"SILENCED twin near line {line} stopped firing raw — the "
+            f"waiver now waives nothing")
 
 
 def test_geometry_scope_is_package_segment_based(tmp_path):
@@ -210,10 +280,101 @@ def test_cli_format_exit_codes_and_fixture_walk_skip(capsys):
     for line in out:
         assert pattern.match(line), line
     # directory walk: fixtures/ is pruned from parent walks, so the tests
-    # tree's planted hazards don't dirty the self-scan
+    # tree's planted hazards (v1 AND v2) don't dirty the self-scan
     files = engine.iter_py_files([os.path.dirname(os.path.dirname(FIXTURE))])
     assert FIXTURE not in files
+    assert FIXTURE_V2 not in files
     assert any(f.endswith("test_firacheck.py") for f in files)
+
+
+def test_cli_json_output_and_rules_filter(capsys):
+    """--json emits the machine-readable artifact (per-rule counts +
+    findings array — the check.sh v2 leg format) and --rules restricts
+    the reported set AND the exit status to the named family, with
+    BAD-SUPPRESS/PARSE-ERROR always gating."""
+    import json as json_lib
+
+    rc = firacheck_cli.main(["check", "--quiet", "--json",
+                             "--rules", "FAULT-SITE", FIXTURE_V2])
+    doc = json_lib.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["files"] == 1
+    # under its REAL (non-driver) path only the path-independent rules
+    # fire: the two planted FAULT-SITE hazards survive the filter
+    assert doc["per_rule"]["FAULT-SITE"] == 2
+    assert doc["errors"] == 2
+    # the filter's per_rule keys are exactly the selected family plus
+    # the always-gating meta rules
+    assert set(doc["per_rule"]) == {"FAULT-SITE", "BAD-SUPPRESS",
+                                    "PARSE-ERROR"}
+    # the v2 fixture's driver-scoped SILENCED waivers are unused under
+    # the real path — the dead-waiver lint reports them even filtered
+    assert doc["warnings"] >= 1
+    for f in doc["findings"]:
+        assert set(f) == {"path", "line", "rule", "severity", "message"}
+
+
+def test_cli_rules_filter_rejects_unknown_rule(capsys):
+    rc = firacheck_cli.main(["check", "--rules", "NOT-A-RULE", FIXTURE_V2])
+    assert rc == 2
+    assert "unknown rule id" in capsys.readouterr().err
+
+
+def test_driver_reg_fires_raw_on_v1_fixture_jit_corpus():
+    """The v1 corpus (jit hazards under a package-virtual path) IS an
+    unregistered jit module: DRIVER-REG fires raw at the earliest jit
+    use and is swallowed by the fixture's reasoned waiver."""
+    source = _fixture_source()
+    raw = engine.check_source(VIRTUAL_PATH, source, suppress=False)
+    hits = [f for f in raw if f.rule == "DRIVER-REG"]
+    assert len(hits) == 1
+    suppressed = engine.check_source(VIRTUAL_PATH, source)
+    assert not any(f.rule == "DRIVER-REG" for f in suppressed)
+
+
+def test_driver_reg_flags_unregistered_steppable_module(tmp_path):
+    """A fira_tpu module importing the engine/fleet steppables that is
+    not in _DRIVER_FILES gates (the DRIVER-REG module half)."""
+    pkg = tmp_path / "fira_tpu" / "extra"
+    pkg.mkdir(parents=True)
+    (pkg / "newdriver.py").write_text(
+        "from fira_tpu.decode.engine import SlotEngine\n"
+        "def drive(model, params, cfg):\n"
+        "    return SlotEngine(model, params, cfg)\n")
+    found = engine.check_paths([str(pkg / "newdriver.py")])
+    assert [f.rule for f in found] == ["DRIVER-REG"]
+    assert "_DRIVER_FILES" in found[0].message
+
+
+def test_driver_reg_flags_unregistered_jit_module(tmp_path):
+    pkg = tmp_path / "fira_tpu" / "extra"
+    pkg.mkdir(parents=True)
+    (pkg / "jitmod.py").write_text(
+        "import jax\n"
+        "def make_step(fn):\n"
+        "    return jax.jit(fn)\n")
+    found = engine.check_paths([str(pkg / "jitmod.py")])
+    assert [f.rule for f in found] == ["DRIVER-REG"]
+    assert "jax.jit" in found[0].message
+
+
+def test_driver_reg_flags_driver_unnamed_in_check_sh(tmp_path):
+    """The registry half: a _DRIVER_FILES entry missing from the
+    adjacent scripts/check.sh gates at the entry's line."""
+    (tmp_path / "scripts").mkdir()
+    (tmp_path / "scripts" / "check.sh").write_text(
+        "python -m fira_tpu.analysis.cli check fira_tpu/named/mod.py\n")
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "reg.py").write_text(
+        '_DRIVER_FILES = (\n'
+        '    "fira_tpu/named/mod.py",\n'
+        '    "fira_tpu/unnamed/mod.py",\n'
+        ')\n')
+    found = engine.check_paths([str(pkg / "reg.py")])
+    assert [f.rule for f in found] == ["DRIVER-REG"]
+    assert "unnamed" in found[0].message and "check.sh" in found[0].message
+    assert found[0].line == 3  # the offending entry's own line
 
 
 def test_empty_or_mistyped_path_gates(capsys, tmp_path):

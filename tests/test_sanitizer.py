@@ -135,3 +135,106 @@ def test_compile_count_regression_unfused_and_fused(tiny):
             assert extra == 0, f"fused dispatch {i} recompiled"
         assert guard.compiles_after_warmup() == 0
         assert watcher.count > 0, "capture saw no compiles at all — inert"
+
+
+# --------------------------------------------------------------------------
+# ThreadGuard: the runtime lock-discipline sanitizer (static twin:
+# SHARED-MUT). Armed, a guarded structure's mutation without the owning
+# lock raises at the mutating line; unarmed, nothing is ever wrapped.
+# --------------------------------------------------------------------------
+
+import collections
+import threading
+
+from fira_tpu.ingest.cache import IngestCache
+
+
+def test_thread_guard_lockless_mutation_raises_and_locked_passes():
+    tg = sanitizer.ThreadGuard()
+    lock = tg.lock(threading.Lock(), "L")
+    d = tg.wrap({}, lock, "D")
+    with pytest.raises(sanitizer.LockDisciplineError) as ei:
+        d["x"] = 1
+    assert "without holding its owning lock" in str(ei.value)
+    assert tg.violations and tg.violations[0]["structure"] == "D"
+    with lock:
+        d["x"] = 1           # the disciplined write
+        d.pop("x")
+        d.setdefault("y", 2)
+    assert dict(d) == {"y": 2}
+    # Counter increments route through __setitem__ — the unlocked-
+    # increment bug class the FaultInjector.fired fix addressed
+    c = tg.wrap(collections.Counter(), lock, "C")
+    with pytest.raises(sanitizer.LockDisciplineError):
+        c["site"] += 1
+    with lock:
+        c["site"] += 1
+    assert c["site"] == 1
+
+
+def test_thread_guard_cross_thread_violation_names_the_thread():
+    tg = sanitizer.ThreadGuard()
+    lock = tg.lock(threading.Lock(), "L")
+    d = tg.wrap({}, lock, "D")
+    box = {}
+
+    def worker():
+        try:
+            d["k"] = 1   # no lock held on THIS thread
+        except sanitizer.LockDisciplineError as e:
+            box["err"] = str(e)
+
+    with lock:  # holding it on the MAIN thread must not authorize others
+        t = threading.Thread(target=worker, name="rogue")
+        t.start()
+        t.join()
+    assert "rogue" in box["err"]
+
+
+def test_thread_guard_records_lock_order_inversion():
+    tg = sanitizer.ThreadGuard()
+    a = tg.lock(threading.Lock(), "A")
+    b = tg.lock(threading.Lock(), "B")
+    with a:
+        with b:
+            pass
+    assert not tg.inversions   # one consistent order: no inversion
+    with b:
+        with a:
+            pass
+    assert len(tg.inversions) == 1
+    assert tg.summary()["inversions"]
+
+
+def test_thread_guard_unarmed_is_plain_and_armed_wraps():
+    # unarmed: plain structures, nothing to pay
+    c = IngestCache(entries=4)
+    assert type(c._lru) is collections.OrderedDict
+    assert not isinstance(c._lock, sanitizer._GuardedLock)
+    # armed: construction wraps; the class's own locked paths still work
+    with sanitizer.thread_guarding() as tg:
+        g = IngestCache(entries=4)
+        assert isinstance(g._lock, sanitizer._GuardedLock)
+        g.put("d", {"x": np.zeros(3, np.int32)})
+        out, outcome = g.take("d")
+        assert outcome == "hit" and out is not None
+        # a lock-bypassing mutation raises AT the mutating line
+        with pytest.raises(sanitizer.LockDisciplineError):
+            g._lru["evil"] = None
+        assert tg.violations
+    # guard restored off: new constructions are plain again
+    assert type(IngestCache(entries=4)._lru) is collections.OrderedDict
+
+
+def test_thread_guard_feeder_ordered_channel_guarded():
+    """The feeder's worker<->consumer ready channel works under the
+    guard (every real write site already holds the condition) and the
+    stream stays byte-order identical."""
+    with sanitizer.thread_guarding():
+        tasks = ((lambda i=i: {"valid": np.ones(2, bool),
+                               "payload": np.full(3, i)}) for i in range(8))
+        from fira_tpu.data.feeder import Feeder
+
+        with Feeder(tasks, num_workers=3, depth=2, put=False) as feed:
+            order = [item.index for item in feed]
+    assert order == list(range(8))
