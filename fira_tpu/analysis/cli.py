@@ -5,6 +5,7 @@ Usage:
     python -m fira_tpu.analysis.cli check --no-suppress fira_tpu
     python -m fira_tpu.analysis.cli check --json fira_tpu tests scripts
     python -m fira_tpu.analysis.cli check --rules SHARED-MUT,FAULT-SITE fira_tpu
+    python -m fira_tpu.analysis.cli check --sarif out.sarif fira_tpu
     python -m fira_tpu.analysis.cli list-rules
 
 ``check`` prints one ``file:line [RULE-ID] severity: message`` per finding
@@ -14,7 +15,10 @@ reviewer uses to audit the committed baseline. ``--json`` emits one
 machine-readable document on stdout (per-rule counts + a findings array —
 the check.sh artifact format); ``--rules`` restricts reporting AND the
 exit status to the named rule ids, so a scan leg can gate on one rule
-family without re-litigating the whole baseline.
+family without re-litigating the whole baseline. ``--sarif PATH``
+additionally writes the findings as a SARIF 2.1.0 log to PATH — the
+interchange format code-review UIs ingest — without changing what goes
+to stdout or the exit status.
 """
 
 from __future__ import annotations
@@ -48,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
                           "findings: [{path, line, rule, severity, "
                           "message}]} — the check.sh artifact format. "
                           "Exit codes are unchanged")
+    chk.add_argument("--sarif", default=None, metavar="PATH",
+                     help="also write the findings as a SARIF 2.1.0 log "
+                          "to PATH (stdout output and exit codes are "
+                          "unchanged; composes with --rules/--json)")
     chk.add_argument("--rules", default=None, metavar="RULE[,RULE...]",
                      help="restrict reporting and exit status to these "
                           "rule ids (BAD-SUPPRESS and PARSE-ERROR always "
@@ -62,6 +70,39 @@ def build_parser() -> argparse.ArgumentParser:
 # waiver or an unparseable file would report "clean" over a scan that
 # never actually ran
 _META_RULES = ("BAD-SUPPRESS", "PARSE-ERROR")
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def sarif_document(findings, rule_ids) -> dict:
+    """The findings as one SARIF 2.1.0 run. ``rule_ids`` is the reported
+    rule universe (the --rules selection or the full registry): every id
+    appears in the driver's rules array whether or not it fired, so a
+    consumer can tell "rule ran clean" from "rule didn't run"."""
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "firacheck",
+                "informationUri": "docs/ANALYSIS.md",
+                "rules": [{"id": r,
+                           "shortDescription": {"text": RULES[r]}}
+                          for r in sorted(rule_ids)],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": str(f.severity),
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/")},
+                    "region": {"startLine": f.line},
+                }}],
+            } for f in findings],
+        }],
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -110,6 +151,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings = [f for f in findings if f.rule in selected]
     n_err = sum(1 for f in findings if f.severity is Severity.ERROR)
     n_warn = len(findings) - n_err
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(sarif_document(findings, selected or set(RULES)),
+                      fh, indent=1)
+            fh.write("\n")
     if args.json:
         per_rule = {r: 0 for r in sorted(selected or RULES)}
         for f in findings:

@@ -3,10 +3,13 @@
 Pass 1 collects the cross-file registries: the donating-factory registry
 (functions whose return is ``jax.jit(..., donate_argnums=...)``, e.g.
 train/step.py:jit_train_step) so DONATION reasons about call sites in
-OTHER files by name, and the contract registry (``*_errors`` validator
+OTHER files by name, the contract registry (``*_errors`` validator
 fields + the fault-site tables — rules_contracts.ContractRegistry) so
-the v2 contract lints reason across the whole scan. Pass 2 runs every
-rule per file, then folds in the ``# firacheck: allow[...]`` waivers.
+the v2 contract lints reason across the whole scan, and the module-set
+call graph (callgraph.CallGraph over every parsed tree) so the v3
+interprocedural rules resolve calls and summaries across files. Pass 2
+runs every rule per file, then folds in the ``# firacheck: allow[...]``
+waivers.
 """
 
 from __future__ import annotations
@@ -16,8 +19,10 @@ import os
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from fira_tpu.analysis import (astutil, rules_concurrency, rules_contracts,
-                               rules_purity, rules_sync, rules_trace)
+                               rules_determinism, rules_purity,
+                               rules_resources, rules_sync, rules_trace)
 from fira_tpu.analysis import suppress as suppress_lib
+from fira_tpu.analysis.callgraph import CallGraph
 from fira_tpu.analysis.findings import Finding, Severity
 
 
@@ -53,6 +58,7 @@ def check_source(path: str, source: str, *,
                      rules_contracts.ContractRegistry] = None,
                  suppress: bool = True,
                  tree: Optional[ast.AST] = None,
+                 graph: Optional[CallGraph] = None,
                  ) -> List[Finding]:
     """Analyze one in-memory source; returns surviving findings.
 
@@ -61,6 +67,8 @@ def check_source(path: str, source: str, *,
     lets check_paths reuse its registry-pass parse. ``contracts``: the
     cross-file contract registry; None builds one from this file alone
     (+ the real fault-site table — the single-file fixture path).
+    ``graph``: the scan-wide call graph; None builds a single-file graph
+    (same-module resolution still works — the fixture path).
     """
     tree = tree if tree is not None else _parse(path, source)
     if tree is None:
@@ -73,6 +81,8 @@ def check_source(path: str, source: str, *,
         contracts = rules_contracts.ContractRegistry()
         rules_contracts.collect(path, tree, contracts)
         rules_contracts.finalize(contracts)
+    if graph is None:
+        graph = CallGraph.build({path: tree})
     parents = astutil.parent_map(tree)
     spans = astutil.hot_spans(tree, path, parents)
     findings: List[Finding] = []
@@ -87,6 +97,8 @@ def check_source(path: str, source: str, *,
     findings += rules_concurrency.check(path, tree, source, parents, spans)
     findings += rules_contracts.check(path, tree, source, parents, spans,
                                       registry=contracts)
+    findings += rules_resources.check(path, tree, source, parents, graph)
+    findings += rules_determinism.check(path, tree, source, parents, graph)
 
     sups, bad = suppress_lib.parse_suppressions(path, source)
     if not suppress:
@@ -123,12 +135,13 @@ def check_paths(paths: Iterable[str], *, suppress: bool = True,
             factories.update(rules_trace.collect_donating_factories(tree))
             rules_contracts.collect(path, tree, contracts)
     rules_contracts.finalize(contracts)
+    graph = CallGraph.build(trees)  # v3 interprocedural index (pass 1)
     for path in files:
         if path in sources:
             findings += check_source(path, sources[path],
                                      factories=factories,
                                      contracts=contracts, suppress=suppress,
-                                     tree=trees.get(path))
+                                     tree=trees.get(path), graph=graph)
     return findings
 
 

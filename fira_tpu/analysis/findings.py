@@ -96,4 +96,20 @@ RULES = {
         "a module dispatching jitted programs or driving engine/fleet "
         "steppables that is not registered in astutil._DRIVER_FILES, or "
         "a registered driver module not named in scripts/check.sh"),
+    # --- v3: interprocedural rules (callgraph.py + dataflow.py) ---
+    "RES-LEAK": (
+        "a tracked resource (KV block grant, started Thread, executor "
+        "pool, open() handle, Event wakeup) whose release a raising path "
+        "can skip — no finally/with covers the window between acquire "
+        "and release, traced through calls via the module call graph"),
+    "DET-TAINT": (
+        "a value carrying nondeterministic order (settle-order dict/set "
+        "iteration, unsorted os.listdir, as_completed) flows into a "
+        "byte-contract sink (OrderedStreamWriter, metrics/journal "
+        "serialization, keyed digests, BLEU) — traced across calls"),
+    "STATS-SCHEMA": (
+        "a *Stats field the metrics summary() never serializes, a "
+        "summary() read of undeclared state, or an EngineStats/"
+        "FleetStats/ServeStats field not named under docs/ — the "
+        "observability schema and its consumers drifting apart"),
 }

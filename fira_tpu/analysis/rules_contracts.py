@@ -23,6 +23,16 @@ hand — each encodes a promise some other file silently depends on:
   ``scripts/check.sh``: otherwise its dispatch loops are invisible to
   the hot-region rules and a future check.sh refactor can drop it from
   the scan (the PR 2-13 convention, now enforced).
+- STATS-SCHEMA (v3) — the observability contract for ``*Stats``
+  classes that own a ``summary()``: (a) every declared field is READ by
+  ``summary()`` or a helper/property it reaches (a field the snapshot
+  never serializes is invisible drift — the ``workers`` /
+  ``pipeline_depth`` class of bug PR 13 closed by hand); (b) every
+  ``self.X`` the summary closure reads is a declared field / method /
+  assigned attribute of the class (the typo'd-key direction); (c) for
+  the repo's real stats classes (:data:`_STATS_DOC_CLASSES`), every
+  field is named somewhere under ``docs/`` — a serialized key nobody
+  documented is a key consumers cannot rely on (WARNING).
 
 The cross-file state lives in :class:`ContractRegistry`, merged by the
 engine's pass 1 exactly like the donation-factory registry. When the
@@ -45,6 +55,9 @@ from fira_tpu.analysis.findings import Finding, Severity
 _PLAIN_TYPES = {"int", "float", "str"}
 _INJECTOR_HINTS = ("fault", "injector")
 _STEPPABLE_NAMES = {"SlotEngine", "EngineFleet"}
+# the real observability classes whose fields must also be docs-named;
+# fixture *Stats classes get checks (a)/(b) but not the docs half
+_STATS_DOC_CLASSES = ("EngineStats", "FleetStats", "ServeStats")
 
 
 @dataclasses.dataclass
@@ -379,6 +392,153 @@ def check_driver_names(path: str, tree: ast.AST) -> List[Finding]:
     return findings
 
 
+# --------------------------------------------------------------------------
+# STATS-SCHEMA (v3)
+# --------------------------------------------------------------------------
+
+def _stats_members(cls: ast.ClassDef) -> Tuple[Dict[str, int], Set[str],
+                                               Set[str], Set[str]]:
+    """(fields -> line, method names, property names, self-assigned
+    attrs) for one class body."""
+    fields: Dict[str, int] = {}
+    methods: Set[str] = set()
+    props: Set[str] = set()
+    assigned: Set[str] = set()
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                          ast.Name):
+            ann = astutil.dotted(node.annotation) or ""
+            if astutil.last_segment(ann) != "ClassVar":
+                fields[node.target.id] = node.lineno
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            methods.add(node.name)
+            if any(astutil.dotted(d) in ("property", "functools.cached_property",
+                                         "cached_property")
+                   for d in node.decorator_list):
+                props.add(node.name)
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Store) \
+                and isinstance(node.value, ast.Name) and node.value.id == "self":
+            assigned.add(node.attr)
+    return fields, methods, props, assigned
+
+
+def _summary_closure(cls: ast.ClassDef, methods: Set[str],
+                     props: Set[str]) -> Set[str]:
+    """Methods/properties transitively reachable from summary(): follow
+    ``self.m(...)`` calls and ``self.p`` property reads."""
+    bodies = {n.name: n for n in cls.body
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    closure: Set[str] = set()
+    frontier = ["summary"]
+    while frontier:
+        name = frontier.pop()
+        if name in closure or name not in bodies:
+            continue
+        closure.add(name)
+        for node in ast.walk(bodies[name]):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self":
+                if node.attr in methods and (
+                        node.attr in props
+                        or isinstance(node.ctx, ast.Load)):
+                    frontier.append(node.attr)
+    return closure
+
+
+def _docs_text(path: str) -> Optional[str]:
+    """Concatenated docs/*.md found by walking up from the scanned file
+    (same discovery as _find_check_sh); None when this checkout carries
+    no docs tree — the docs half of STATS-SCHEMA then stays disarmed."""
+    d = os.path.dirname(astutil.normalize_path(path))
+    for _ in range(6):
+        cand = os.path.join(d, "docs")
+        if os.path.isdir(cand):
+            chunks = []
+            try:
+                for name in sorted(os.listdir(cand)):
+                    if name.endswith(".md"):
+                        with open(os.path.join(cand, name),
+                                  encoding="utf-8", errors="replace") as f:
+                            chunks.append(f.read())
+            except OSError:
+                return None
+            return "\n".join(chunks)
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+def check_stats_schema(path: str, tree: ast.AST) -> List[Finding]:
+    """STATS-SCHEMA: see the module docstring. Purely per-file — a
+    stats class and its summary() always live together."""
+    import re
+
+    findings: List[Finding] = []
+    docs: Optional[str] = None
+    docs_loaded = False
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name.endswith("Stats")):
+            continue
+        fields, methods, props, assigned = _stats_members(cls)
+        if "summary" not in methods or not fields:
+            continue
+        closure = _summary_closure(cls, methods, props)
+        reads: Set[str] = set()
+        bodies = {n.name: n for n in cls.body
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for name in closure:
+            for node in ast.walk(bodies[name]):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self":
+                    reads.add(node.attr)
+        for field, line in sorted(fields.items(), key=lambda kv: kv[1]):
+            if field not in reads:
+                findings.append(Finding(
+                    path, line, "STATS-SCHEMA", Severity.ERROR,
+                    f"{cls.name}.{field} is never serialized: summary() "
+                    f"and the helpers it reaches never read "
+                    f"self.{field}, so the metrics snapshot silently "
+                    f"drops the field — serialize it or delete it"))
+        declared = set(fields) | methods | assigned
+        for name in sorted(closure):
+            for node in ast.walk(bodies[name]):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and node.attr not in declared:
+                    findings.append(Finding(
+                        path, node.lineno, "STATS-SCHEMA", Severity.ERROR,
+                        f"summary() path reads self.{node.attr} which "
+                        f"{cls.name} never declares as a field, method, "
+                        f"or assigned attribute — a serialized key with "
+                        f"no backing state (the workers/pipeline_depth "
+                        f"drift class)"))
+                    declared.add(node.attr)  # one finding per name
+        if cls.name in _STATS_DOC_CLASSES:
+            if not docs_loaded:
+                docs = _docs_text(path)
+                docs_loaded = True
+            if docs is not None:
+                for field, line in sorted(fields.items(),
+                                          key=lambda kv: kv[1]):
+                    if not re.search(rf"\b{re.escape(field)}\b", docs):
+                        findings.append(Finding(
+                            path, line, "STATS-SCHEMA", Severity.WARNING,
+                            f"{cls.name}.{field} is not named anywhere "
+                            f"under docs/ — a metrics key consumers "
+                            f"cannot rely on; add it to the stats table "
+                            f"in docs/ANALYSIS.md"))
+    return findings
+
+
 def check(path: str, tree: ast.AST, source: str, parents, spans, *,
           registry: Optional[ContractRegistry] = None) -> List[Finding]:
     registry = registry if registry is not None else ContractRegistry()
@@ -387,4 +547,5 @@ def check(path: str, tree: ast.AST, source: str, parents, spans, *,
     findings += check_fault_site(path, tree, registry)
     findings += check_driver_reg(path, tree)
     findings += check_driver_names(path, tree)
+    findings += check_stats_schema(path, tree)
     return findings

@@ -25,6 +25,22 @@
   Unarmed, nothing is wrapped: the structures are plain dicts/Counters
   and the only cost is one is-None branch at construction — the
   CompileGuard zero-overhead discipline.
+- :class:`LeakGuard`: the resource-lifecycle sanitizer (static twin:
+  RES-LEAK). While armed, the acquire/release pairs the static rule
+  reasons about are ALSO tracked at runtime — paged-block grants
+  (decode/engine.py's refcounted allocator), pipeline threads
+  (data/feeder.py start/join, robust/watchdog.py's deliberately
+  abandoned dispatch thread), and the ingest process pool
+  (ingest/cache.py). Every acquire records its acquire SITE
+  (file:line in function); ``assert_clean()`` at engine/fleet/serve
+  teardown raises :class:`LeakError` naming the acquire site of every
+  resource still held — the dynamic proof of the bug class the static
+  rule flags, and the chaos harness's leak oracle. The watchdog's
+  abandoned thread is SANCTIONED via :meth:`LeakGuard.abandon_thread`
+  (moved to the ``abandoned`` book with its reason, not counted as a
+  leak) — an armed teardown distinguishes "leaked" from "abandoned by
+  design". Unarmed, ``leak_guard()`` is None and every call site is
+  one is-None branch — no record, no allocation, no lock.
 
 The guard is deliberately per-label, not global: a fused-steps run
 legitimately compiles the grouped program at step 1 and the per-step
@@ -37,6 +53,8 @@ import collections
 import contextlib
 import dataclasses
 import logging
+import os
+import sys
 import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -54,6 +72,12 @@ class RetraceError(RuntimeError):
 class LockDisciplineError(RuntimeError):
     """A guarded shared structure was mutated by a thread that does not
     hold its owning lock (ThreadGuard; static twin: SHARED-MUT)."""
+
+
+class LeakError(RuntimeError):
+    """A tracked resource was still held at a teardown assert_clean()
+    (LeakGuard; static twin: RES-LEAK). The message names every leaked
+    resource's ACQUIRE site — the line that owes the release."""
 
 
 def program_label(kind: str, tag: Optional[str] = None, group: int = 1) -> str:
@@ -395,11 +419,161 @@ class ThreadGuard:
                     "inversions": list(self.inversions)}
 
 
+# --------------------------------------------------------------------------
+# LeakGuard: the runtime resource-lifecycle sanitizer (static twin:
+# RES-LEAK / rules_resources.py)
+# --------------------------------------------------------------------------
+
+class LeakGuard:
+    """Runtime acquire/release ledger (docs/ANALYSIS.md "Runtime
+    sanitizer"): every tracked acquire records its acquire site, every
+    release retires the record, and :meth:`assert_clean` at teardown
+    raises :class:`LeakError` naming the acquire site of whatever is
+    still held.
+
+    Usage (the pattern decode/engine.py, data/feeder.py and
+    ingest/cache.py follow)::
+
+        self._leaks = leak_guard()    # None when unarmed
+        ...
+        if self._leaks is not None:
+            self._leaks.note_acquire("block", key, what="paged block 3")
+
+    Resources are keyed ``(kind, key)`` where the caller's key embeds
+    ``@{id(owner):x}`` so two engines never alias each other's blocks.
+    Threads get dedicated helpers (:meth:`track_thread` /
+    :meth:`note_joined` / :meth:`abandon_thread`) keyed by the thread
+    object, so track and join sites never have to agree on a string.
+    ``abandon_thread`` is the watchdog's sanction: a deliberately
+    abandoned dispatch thread moves to the :attr:`abandoned` book with
+    its reason instead of counting as a leak.
+    """
+
+    def __init__(self) -> None:
+        self._meta = threading.Lock()
+        self._open: Dict[Tuple[str, str], Dict] = {}
+        self.abandoned: List[Dict] = []
+        self.acquires = 0
+        self.releases = 0
+        # releases with no matching acquire: 0 on a healthy run — a
+        # nonzero count means a double-release or an untracked acquire
+        self.unmatched_releases = 0
+
+    @staticmethod
+    def _site(skip: int) -> str:
+        """``file.py:line in func`` for the frame ``skip`` levels above
+        the caller of this method — the acquire site a LeakError names."""
+        f = sys._getframe(skip + 1)
+        return (f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno} "
+                f"in {f.f_code.co_name}")
+
+    @staticmethod
+    def _thread_key(thread: threading.Thread) -> str:
+        return f"{thread.name}@{id(thread):x}"
+
+    # --- the ledger ---
+
+    def note_acquire(self, kind: str, key: str, what: str = "",
+                     site: Optional[str] = None) -> None:
+        site = site if site is not None else self._site(1)
+        record = {"kind": kind, "key": str(key), "what": what or kind,
+                  "site": site,
+                  "thread": threading.current_thread().name}
+        with self._meta:
+            self.acquires += 1
+            self._open[(kind, str(key))] = record
+
+    def note_release(self, kind: str, key: str) -> None:
+        with self._meta:
+            self.releases += 1
+            if self._open.pop((kind, str(key)), None) is None:
+                self.unmatched_releases += 1
+
+    def track_thread(self, thread: threading.Thread,
+                     what: str = "") -> None:
+        self.note_acquire("thread", self._thread_key(thread),
+                          what=what or f"thread '{thread.name}'",
+                          site=self._site(1))
+
+    def note_joined(self, thread: threading.Thread) -> None:
+        self.note_release("thread", self._thread_key(thread))
+
+    def abandon_thread(self, thread: threading.Thread,
+                       reason: str) -> None:
+        """Sanction a deliberately unjoined thread (the watchdog's
+        abandoned dispatch): the record moves to :attr:`abandoned` with
+        its reason and no longer counts as held."""
+        with self._meta:
+            rec = self._open.pop(("thread", self._thread_key(thread)),
+                                 None)
+            if rec is not None:
+                rec["reason"] = reason
+                self.abandoned.append(rec)
+
+    # --- the teardown oracle ---
+
+    def open_resources(self) -> List[Dict]:
+        with self._meta:
+            return list(self._open.values())
+
+    def assert_clean(self, scope: str = "teardown") -> None:
+        """Raise :class:`LeakError` naming the acquire site of every
+        resource still held (sanctioned abandons excluded). The
+        engine/fleet/serve teardown call — the dynamic twin of a
+        RES-LEAK finding."""
+        leaks = self.open_resources()
+        if not leaks:
+            return
+        sites = "; ".join(
+            f"{r['what']} ({r['kind']} '{r['key']}') acquired at "
+            f"{r['site']}" for r in leaks[:5])
+        more = f" (+{len(leaks) - 5} more)" if len(leaks) > 5 else ""
+        raise LeakError(
+            f"sanitizer: {len(leaks)} resource(s) still held at {scope}: "
+            f"{sites}{more} — every acquire owes a release on every exit "
+            f"path (RES-LEAK discipline)")
+
+    def summary(self) -> Dict:
+        with self._meta:
+            return {"acquires": self.acquires,
+                    "releases": self.releases,
+                    "open": len(self._open),
+                    "abandoned": len(self.abandoned),
+                    "unmatched_releases": self.unmatched_releases}
+
+
 # process-global arming point: the threaded structures are constructed
 # deep inside worker machinery, so they look the guard up here instead
 # of threading it through every constructor. None = unarmed = nothing
 # is ever wrapped (the zero-overhead contract).
 _THREAD_GUARD: Optional[ThreadGuard] = None
+# same contract for the resource ledger: None = unarmed = every tracked
+# call site is one is-None branch and nothing is recorded.
+_LEAK_GUARD: Optional[LeakGuard] = None
+
+
+def leak_guard() -> Optional[LeakGuard]:
+    """The armed LeakGuard, or None. Captured at construction time by
+    the tracked owners (FiraDecodeEngine, Feeder, IngestExecutor) so an
+    owner's whole lifecycle reports to ONE ledger even if arming flips
+    mid-run."""
+    return _LEAK_GUARD
+
+
+@contextlib.contextmanager
+def leak_guarding(guard: Optional[LeakGuard] = None
+                  ) -> Iterator[LeakGuard]:
+    """Arm a LeakGuard for the block (tests / chaos harness; jax-free).
+    Owners constructed INSIDE the block are tracked; pre-existing ones
+    are not (arming is a construction-time choice, like ThreadGuard)."""
+    global _LEAK_GUARD
+    prev = _LEAK_GUARD
+    lg = guard if guard is not None else LeakGuard()
+    _LEAK_GUARD = lg
+    try:
+        yield lg
+    finally:
+        _LEAK_GUARD = prev
 
 
 def thread_guard() -> Optional[ThreadGuard]:
@@ -492,12 +666,14 @@ def arm(enabled: bool = True, *, nans: bool = True, infs: bool = True,
         lg.addHandler(watcher)
         if lg.getEffectiveLevel() > logging.WARNING:
             lg.setLevel(logging.WARNING)
-    # lock-discipline sanitizer: process-lifetime arming like the rest of
-    # this function — threaded shared structures constructed from here on
-    # are guarded proxies (docstring above; thread_guarding() is the
-    # scoped alternative for library callers/tests)
-    global _THREAD_GUARD
+    # lock-discipline + resource-lifecycle sanitizers: process-lifetime
+    # arming like the rest of this function — threaded shared structures
+    # and resource owners constructed from here on are guarded
+    # (docstring above; thread_guarding()/leak_guarding() are the scoped
+    # alternatives for library callers/tests)
+    global _THREAD_GUARD, _LEAK_GUARD
     _THREAD_GUARD = ThreadGuard()
+    _LEAK_GUARD = LeakGuard()
     return CompileGuard(watcher)
 
 
@@ -520,7 +696,8 @@ def sanitize(enabled: bool = True, *, nans: bool = True, infs: bool = True,
     jax.config.update("jax_debug_nans", nans)
     jax.config.update("jax_debug_infs", infs)
     try:
-        with compile_capture() as watcher, thread_guarding():
+        with compile_capture() as watcher, thread_guarding(), \
+                leak_guarding():
             yield CompileGuard(watcher)
     finally:
         jax.config.update("jax_debug_nans", prev_nans)

@@ -155,6 +155,13 @@ class Feeder:
         self._n_task_retries = 0
         self._task_s = 0.0
         self._closed = False
+        # resource-lifecycle sanitizer: armed, every pipeline thread is
+        # ledgered at start and retired at join, so a close() path that
+        # skips a join shows up at teardown with this start site named
+        # (analysis.sanitizer.LeakGuard; static twin: RES-LEAK)
+        from fira_tpu.analysis.sanitizer import leak_guard
+
+        self._leaks = leak_guard()
 
         if num_workers == 0:
             self._task_iter: Iterator[Task] = iter(tasks)
@@ -187,6 +194,8 @@ class Feeder:
         ]
         for t in self._threads:
             t.start()
+            if self._leaks is not None:
+                self._leaks.track_thread(t)
 
     # --- pipeline threads ---
 
@@ -372,6 +381,8 @@ class Feeder:
             self._cond.notify_all()
         for t in self._threads:
             t.join()
+            if self._leaks is not None:
+                self._leaks.note_joined(t)
         self._threads = []
 
     def __enter__(self) -> "Feeder":

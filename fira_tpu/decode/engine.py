@@ -135,7 +135,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fira_tpu.analysis.sanitizer import program_label
+from fira_tpu.analysis.sanitizer import leak_guard, program_label
 from fira_tpu.config import FiraConfig
 from fira_tpu.decode import paging
 from fira_tpu.decode import prefix_cache as prefix_cache_lib
@@ -310,6 +310,12 @@ class SlotEngine:
         # piece bails early on it, including an abandoned watchdog thread
         # that wakes up after the retirement (docs/FAULTS.md).
         self._faults = faults
+        # resource-lifecycle sanitizer (--sanitize / chaos harness):
+        # armed, every paged-block grant is ledgered with its acquire
+        # site and assert_clean() at teardown names what leaked; unarmed
+        # (None — the default) each allocator path pays one is-None
+        # branch and records nothing (analysis.sanitizer.LeakGuard)
+        self._leaks = leak_guard()
         self.retired = False
         self.slots = int(slots or cfg.engine_slots or cfg.test_batch_size)
         if self.slots < 1:
@@ -819,6 +825,11 @@ class SlotEngine:
                 f"block {b} granted while already held (double grant)"
             self._block_refs[b] = 1
             grant.append(b)
+        if self._leaks is not None:
+            for b in grant:
+                self._leaks.note_acquire(
+                    "block", f"{self.tag or 'engine'}@{id(self):x}:{b}",
+                    what=f"paged block {b}")
         return grant
 
     def _release_blocks(self, blocks) -> None:
@@ -836,6 +847,9 @@ class SlotEngine:
             if n == 1:
                 del self._block_refs[b]
                 self._free_blocks.append(b)
+                if self._leaks is not None:
+                    self._leaks.note_release(
+                        "block", f"{self.tag or 'engine'}@{id(self):x}:{b}")
             else:
                 self._block_refs[b] = n - 1
 

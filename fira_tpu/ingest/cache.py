@@ -574,6 +574,13 @@ class IngestExecutor:
         self._memo = memo
         self._pool = None
         self._has_context = context is not None
+        # resource-lifecycle sanitizer: armed, the process pool is
+        # ledgered at construction and retired at close(), so a serve
+        # path that drops the executor without shutdown is named at
+        # teardown (analysis.sanitizer.LeakGuard; static twin: RES-LEAK)
+        from fira_tpu.analysis.sanitizer import leak_guard
+
+        self._leaks = leak_guard()
         if mode == "process":
             import concurrent.futures
             import multiprocessing
@@ -586,6 +593,10 @@ class IngestExecutor:
             self._pool = concurrent.futures.ProcessPoolExecutor(
                 max_workers=max(1, int(workers)), mp_context=ctx,
                 initializer=_proc_init, initargs=(context,))
+            if self._leaks is not None:
+                self._leaks.note_acquire(
+                    "pool", f"IngestExecutor@{id(self):x}",
+                    what=f"process pool ({max(1, int(workers))} workers)")
 
     @property
     def offloads_requests(self) -> bool:
@@ -615,6 +626,9 @@ class IngestExecutor:
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
+            if self._leaks is not None:
+                self._leaks.note_release("pool",
+                                         f"IngestExecutor@{id(self):x}")
 
     def __enter__(self) -> "IngestExecutor":
         return self

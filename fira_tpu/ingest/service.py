@@ -677,6 +677,14 @@ def serve_diffs(model, params, word_vocab: Vocab, ast_change_vocab: Vocab,
     finally:
         if own_executor is not None:
             own_executor.close()
+    # same teardown oracle as serve.server.serve_split: armed, a leaked
+    # block/thread/pool raises here naming its acquire site (success
+    # path only — a serve error must not be masked by its leak fallout)
+    from fira_tpu.analysis.sanitizer import leak_guard
+
+    lg = leak_guard()
+    if lg is not None:
+        lg.assert_clean("serve_diffs teardown")
     return finalize_serve_result(stats, owner, faults, out_path=out_path,
                                  bleu_by_pos=bleu_by_pos,
                                  metrics_path=metrics_path)

@@ -146,11 +146,18 @@ class Journal:
         # resume APPENDS a new generation (the prior records are the
         # recovery source); a fresh run truncates
         self._f = open(path, "a" if resume else "w")
-        self.append({"kind": "begin", "n": int(n),
-                     "times_digest": times_digest(times),
-                     "mix_digest": (times_digest(mix) if mix is not None
-                                    else None),
-                     "resume": bool(resume)})
+        try:
+            self.append({"kind": "begin", "n": int(n),
+                         "times_digest": times_digest(times),
+                         "mix_digest": (times_digest(mix) if mix is not None
+                                        else None),
+                         "resume": bool(resume)})
+        except BaseException:
+            # the begin-record fsync can fail (full/dying disk); no caller
+            # holds the half-built Journal yet, so nobody else can close
+            # the handle we just opened (firacheck RES-LEAK)
+            self._f.close()
+            raise
 
     def append(self, rec: Dict) -> None:
         self._f.write(json.dumps(rec) + "\n")

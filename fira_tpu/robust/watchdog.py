@@ -56,14 +56,27 @@ def run_with_watchdog(fn: Callable[[], Any], timeout_s: float, *,
 
     t = threading.Thread(target=body, name="fira-dispatch-watchdog",
                          daemon=True)
+    from fira_tpu.analysis.sanitizer import leak_guard
+
+    lg = leak_guard()
     t.start()
+    if lg is not None:
+        lg.track_thread(t, what="dispatch-watchdog thread")
     t.join(timeout_s)
     if t.is_alive():
+        if lg is not None:
+            # sanctioned: a blown dispatch is ABANDONED by design — the
+            # daemon thread bails via engine.retired the moment it wakes
+            # (docs/FAULTS.md); the ledger records the reason instead of
+            # calling it a leak at teardown
+            lg.abandon_thread(t, "watchdog expiry — abandoned by design")
         if cancel_event is not None:
             cancel_event.set()
         raise WatchdogTimeout(
             f"dispatch{f' {label}' if label else ''} exceeded the "
             f"{timeout_s:.3f}s wall-clock watchdog and was abandoned")
+    if lg is not None:
+        lg.note_joined(t)
     if "error" in box:
         raise box["error"]
     return box.get("value")

@@ -71,6 +71,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from fira_tpu.analysis import sanitizer
 from fira_tpu.config import FiraConfig
 from fira_tpu.data import buckets as buckets_lib
 from fira_tpu.data.dataset import FiraDataset
@@ -318,6 +319,10 @@ class ServeStats:
         return {
             "offered": n,
             "completed": len(done),
+            # the harvest-order completion timeline (positions in the
+            # order their beams settled) — recorded since PR 11 but only
+            # serialized since the STATS-SCHEMA gate caught the drift
+            "completion_order": list(self.completions),
             "shed_queue_full": self.shed_queue_full,
             "shed_deadline": self.shed_deadline,
             "shed_error": self.shed_error,
@@ -328,7 +333,12 @@ class ServeStats:
             "respawned_replicas": [r["replica"] for r in self.respawns],
             "spare_attaches": sum(1 for r in self.respawns if r["spare"]),
             "replicas_alive_over_time": list(self.replicas_alive_over_time),
-            "heartbeats": {t: dict(h) for t, h in self.heartbeats.items()},
+            # sorted: keys are inserted as replicas first dispatch, and
+            # under real-clock retirement/respawn that order tracks wall
+            # timing — identical request streams must serialize identical
+            # metrics bytes (firacheck DET-TAINT)
+            "heartbeats": {t: dict(h)
+                           for t, h in sorted(self.heartbeats.items())},
             "admission_paused_rounds": self.admission_paused_rounds,
             "resumed": self.resumed,
             "request_retries": sum(r.retries for r in self.records),
@@ -1678,6 +1688,14 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
     finally:
         if journal is not None:
             journal.close()
+    # resource-lifecycle oracle (analysis.sanitizer.LeakGuard): with the
+    # sanitizer armed, the run ends with every paged-block grant released
+    # and every pipeline thread joined or sanctioned — a leak raises HERE
+    # naming its acquire site, on the success path only (a serve error
+    # must surface as itself, not be masked by its own leak fallout)
+    lg = sanitizer.leak_guard()
+    if lg is not None:
+        lg.assert_clean("serve teardown")
     return finalize_serve_result(stats, owner, faults, out_path=out_path,
                                  bleu_by_pos=bleu_by_pos,
                                  metrics_path=metrics_path)

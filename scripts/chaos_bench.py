@@ -283,20 +283,30 @@ def smoke() -> int:
     # has its own legacy leg above)
     rcfg = ccfg.replace(inject_faults="engine.step:raise:0.06:11")
     inj = faults_lib.injector_from(rcfg)
-    fleet = fleet_lib.EngineFleet(model, params, rcfg, replicas=2,
-                                  faults=inj)
-    data = dataset.splits["train"]
-    table = buckets_lib.decode_table(rcfg)
-    fleet.prewarm(
-        (buckets_lib.warmup_batch(data, rcfg, g, rcfg.test_batch_size),
-         buckets_lib.geom_tag(g)) for g in table)
-    m = serve_split(model, params, dataset, rcfg,
-                    arrival_times=burst_times,
-                    out_dir=os.path.join(work, "retire"), split="train",
-                    clock="virtual", engine=fleet, faults=inj,
-                    request_mix=burst_mix)
+    # LeakGuard armed around CONSTRUCTION (guards are captured when the
+    # owner is built): every paged block the burst grants — retired
+    # replica included — must check back in, and the guard must agree
+    # with the allocator-invariant sweep below.
+    with sanitizer.leak_guarding() as lg:
+        fleet = fleet_lib.EngineFleet(model, params, rcfg, replicas=2,
+                                      faults=inj)
+        data = dataset.splits["train"]
+        table = buckets_lib.decode_table(rcfg)
+        fleet.prewarm(
+            (buckets_lib.warmup_batch(data, rcfg, g,
+                                      rcfg.test_batch_size),
+             buckets_lib.geom_tag(g)) for g in table)
+        m = serve_split(model, params, dataset, rcfg,
+                        arrival_times=burst_times,
+                        out_dir=os.path.join(work, "retire"),
+                        split="train", clock="virtual", engine=fleet,
+                        faults=inj, request_mix=burst_mix)
+    lg_sum = lg.summary()
     sv = m["serve"]
     leaks = []
+    if lg_sum["open"] or not lg_sum["acquires"]:
+        leaks.append(f"leak guard: {lg_sum['open']} open of "
+                     f"{lg_sum['acquires']} acquire(s)")
     for eng in fleet.engines:
         leaks += eng.allocator_invariants()
         if len(eng._free_blocks) != eng._pool_blocks or eng._block_refs:
@@ -321,6 +331,8 @@ def smoke() -> int:
         "dedup_coalesced": sv["dedup_coalesced"],
         "followers_completed": followers_done,
         "shared_block_peak": m["engine"]["shared_block_peak"],
+        "leak_guard_acquires": lg_sum["acquires"],
+        "leak_guard_open": lg_sum["open"],
         **({"block_leaks": leaks[:3]} if leaks else {}),
     })
 

@@ -64,6 +64,24 @@ JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check --json \
     > "$FIRACHECK_JSON" || { cat "$FIRACHECK_JSON"; exit 1; }
 echo "firacheck v2 artifact -> $FIRACHECK_JSON"
 
+echo "== firacheck v3: interprocedural lifecycle + determinism-taint scan (docs/ANALYSIS.md) =="
+# The v3 interprocedural rule families run as their OWN named leg:
+# acquire/release windows tracked through call-graph may-raise
+# summaries and exception edges (RES-LEAK), nondeterministic-order
+# values flowing into byte sinks across function boundaries
+# (DET-TAINT), and stats-class field/serialization/docs drift
+# (STATS-SCHEMA). The full scan above already gates on these too; this
+# leg pins the family exit path and emits BOTH artifacts: the JSON
+# findings dump and a SARIF 2.1.0 log for code-review UI upload.
+FIRACHECK_V3_JSON="${FIRACHECK_V3_JSON:-/tmp/firacheck_v3_scan.json}"
+FIRACHECK_V3_SARIF="${FIRACHECK_V3_SARIF:-/tmp/firacheck_v3_scan.sarif}"
+JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check --json \
+    --sarif "$FIRACHECK_V3_SARIF" \
+    --rules RES-LEAK,DET-TAINT,STATS-SCHEMA \
+    fira_tpu tests scripts \
+    > "$FIRACHECK_V3_JSON" || { cat "$FIRACHECK_V3_JSON"; exit 1; }
+echo "firacheck v3 artifacts -> $FIRACHECK_V3_JSON, $FIRACHECK_V3_SARIF"
+
 echo "== multichip smoke: 2 virtual CPU devices (docs/MULTICHIP.md) =="
 # Mesh paths stay green in tier-1: one sharded grouped-train window plus
 # a 2-replica engine-fleet drain under the compile guard, on 2 logical

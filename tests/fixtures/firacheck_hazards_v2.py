@@ -45,6 +45,7 @@ class CrossThreadMeterHazard:
 
     def start(self):
         self._t = threading.Thread(target=self._work)
+        # firacheck: allow[RES-LEAK] this corpus plants the SHARED-MUT race; the unjoined-thread hazard is owned by the v3 corpus
         self._t.start()
 
     def _work(self):

@@ -1,0 +1,214 @@
+"""Planted-hazard corpus for the firacheck v3 interprocedural rules
+(tests/test_firacheck.py::test_v3_rules_fire_and_match_golden_markers).
+
+NEVER imported — scanned as text under the same VIRTUAL DRIVER PATH as
+the v2 corpus (ends in ``fira_tpu/serve/server.py``), which arms the
+driver-scoped RES-LEAK and DET-TAINT rules; STATS-SCHEMA is per-file
+and needs no driver scope. Every line carrying ``HAZARD[RULE-ID]`` must
+produce exactly that finding; lines whose allow-reason says SILENCED
+must produce none. The cross-function hazards are the point of v3: a
+single-function matcher (v1/v2) provably cannot flag them, because the
+raising site / the byte sink lives in a DIFFERENT function and only the
+call-graph summaries carry the fact across.
+
+Directory walks skip ``fixtures/`` (engine.iter_py_files) — these
+hazards are live on purpose and must not dirty the repo self-scan.
+"""
+
+import json
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+# --- RES-LEAK: straight leaks (window open at end of function) -----------
+
+def straight_leak(path):
+    f = open(path)  # HAZARD[RES-LEAK] handle never closed or handed off
+    data = f.read()
+    return data
+
+
+def thread_leak(fn):
+    t = threading.Thread(target=fn)
+    t.start()  # HAZARD[RES-LEAK] started thread never joined
+    return None
+
+
+def pool_leak(tasks):
+    pool = ThreadPoolExecutor(max_workers=2)  # HAZARD[RES-LEAK] pool never shut down
+    for task in tasks:
+        pool.submit(task)
+
+
+# --- RES-LEAK: leak-on-exception (a may-raise stmt inside the window) ----
+
+def fsync_leak(path):
+    f = open(path, "w")  # HAZARD[RES-LEAK] fsync can raise before the close
+    os.fsync(f.fileno())
+    f.close()
+
+
+def _stamp_header(fh):
+    """The raising site a single-function matcher cannot see."""
+    fh.write("header\n")
+    os.fsync(fh.fileno())
+
+
+def cross_function_leak(path):
+    f = open(path, "w")  # HAZARD[RES-LEAK] helper body fsyncs — only the call-graph summary sees it
+    _stamp_header(f)
+    f.close()
+
+
+class JournalHazard:
+    """The half-built-object class of leak (the Journal-fsync bug):
+    ``self.attr = <resource>`` in __init__ does NOT transfer ownership —
+    no caller holds the object yet, so a raise strands the handle."""
+
+    def __init__(self, path):
+        self._f = open(path, "w")  # HAZARD[RES-LEAK] begin-record fsync can strand the half-built handle
+        self._begin()
+
+    def _begin(self):
+        self._f.write("begin\n")
+        os.fsync(self._f.fileno())
+
+    def close(self):
+        self._f.close()
+
+
+# --- RES-LEAK: controls (each window semantics rule, exercised clean) ----
+
+def with_control(path):
+    with open(path) as f:  # control: context manager = protected
+        return f.read()
+
+
+def finally_control(path):
+    f = open(path, "w")  # control: the finally releases the kind
+    try:
+        os.fsync(f.fileno())
+    finally:
+        f.close()
+
+
+def handoff_control(path, registry):
+    f = open(path)  # control: passed to the registry — it owns the handle now
+    registry.append(f)
+
+
+def return_control(path):
+    f = open(path)  # control: returned — the caller owns the window
+    return f
+
+
+class OwnerControl:
+    def __init__(self):
+        self.pool = ThreadPoolExecutor(max_workers=1)  # control: no raise follows; the built object owns it
+
+    def close(self):
+        self.pool.shutdown(wait=True)
+
+
+def event_control():
+    done = threading.Event()  # control: wakeup handoff belongs to another component
+    done.clear()
+
+
+def leak_waived(path):
+    # firacheck: allow[RES-LEAK] SILENCED planted twin - process-lifetime handle by contract; the OS reaps it at exit
+    f = open(path, "w")
+    f.write("x")
+
+
+# --- DET-TAINT: in-function flows (source -> byte sink) ------------------
+
+class HeartbeatMapHazard:
+    def summary_hazard(self):
+        beats = list(self._beats.values())
+        return json.dumps({"beats": beats})  # HAZARD[DET-TAINT] settle-order dict view into serialized bytes
+
+
+def listdir_hazard(out_writer, root):
+    names = os.listdir(root)
+    for n in names:
+        out_writer.add(n)  # HAZARD[DET-TAINT] unsorted scan order into the ordered output stream
+
+
+def digest_hazard(items, hasher):
+    pending = set(items)
+    for it in pending:
+        hasher.update(it)  # HAZARD[DET-TAINT] set iteration order into a keyed digest
+
+
+# --- DET-TAINT: cross-function flows (the v3 point) ----------------------
+
+def _settled_tags(reg):
+    """The taint only the return summary carries out of this frame."""
+    return set(reg)
+
+
+def cross_return_hazard(reg):
+    tags = _settled_tags(reg)
+    return json.dumps(list(tags))  # HAZARD[DET-TAINT] callee returns an order-tainted value
+
+
+def _write_summary(payload, path):
+    """The sink only the parameter summary carries into this frame."""
+    with open(path, "w") as fh:
+        json.dump(payload, fh)
+
+
+def cross_param_hazard(reg, path):
+    order = set(reg)
+    _write_summary(order, path)  # HAZARD[DET-TAINT] tainted argument forwarded into the callee's json.dump
+
+
+# --- DET-TAINT: controls -------------------------------------------------
+
+def sorted_control(out_writer, root):
+    for n in sorted(os.listdir(root)):
+        out_writer.add(n)  # control: sorted() re-establishes a deterministic order
+
+
+def literal_dict_control():
+    stage = {"parse": 1.0, "lex": 2.0}
+    return json.dumps(dict(stage.items()))  # control: literal-keyed LOCAL dict, not shared settle-order state
+
+
+class WaivedMapStats:
+    def note(self, beats):
+        self._beats = beats
+
+    def render(self):
+        beats = list(self._beats.values())
+        # firacheck: allow[DET-TAINT] SILENCED planted twin - single-threaded fixture map is insertion-ordered by contract
+        return json.dumps(beats)
+
+
+# --- STATS-SCHEMA: summary()/field drift ---------------------------------
+
+class FixtureStats:
+    admitted: int = 0  # control: serialized below
+    dropped: int = 0  # HAZARD[STATS-SCHEMA] field the summary never serializes
+    wall_s: float = 0.0  # control: serialized through the helper closure
+
+    def _timing(self):
+        return {"wall_s": self.wall_s}
+
+    def summary(self):
+        return {
+            "admitted": self.admitted,
+            "timing": self._timing(),
+            "workers": self.workers,  # HAZARD[STATS-SCHEMA] serialized key with no backing field
+        }
+
+
+class WaivedStats:
+    # firacheck: allow[STATS-SCHEMA] SILENCED planted twin - carried for a downstream tool; deliberately not serialized
+    carried: int = 0
+    shown: int = 0
+
+    def summary(self):
+        return {"shown": self.shown}

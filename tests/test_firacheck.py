@@ -29,6 +29,8 @@ FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "fixtures", "firacheck_hazards.py")
 FIXTURE_V2 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "fixtures", "firacheck_hazards_v2.py")
+FIXTURE_V3 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "fixtures", "firacheck_hazards_v3.py")
 # virtual path: arms the fira_tpu-scoped GEOMETRY-DRIFT rule while keeping
 # the hot-region logic identical (not a designated driver file)
 VIRTUAL_PATH = "fira_tpu/model/firacheck_hazards.py"
@@ -44,6 +46,7 @@ V1_RULES = {"HOST-SYNC", "RETRACE", "DONATION", "PRNG-REUSE",
 V2_FIXTURE_RULES = {"SHARED-MUT", "RETIRED-RECHECK", "SCHED-BLOCK",
                     "WALL-CLOCK", "FLOAT-ORDER", "KNOB-VALIDATE",
                     "FAULT-SITE"}
+V3_FIXTURE_RULES = {"RES-LEAK", "DET-TAINT", "STATS-SCHEMA"}
 
 _MARKER = re.compile(r"HAZARD\[([A-Z-]+)\]")
 
@@ -130,6 +133,90 @@ def test_v2_silenced_twins_are_suppressed_but_fire_raw():
         assert line in raw_lines, (
             f"SILENCED twin near line {line} stopped firing raw — the "
             f"waiver now waives nothing")
+
+
+# distinctive message text per v3 rule; the RES-LEAK and DET-TAINT pins
+# each include one CROSS-FUNCTION chain (`A() at file:line -> site`,
+# `callee() -> source`, `sink inside callee()`) — the interprocedural
+# capability v1/v2 provably lack, pinned so a refactor can't lose it
+_V3_MESSAGE_PINS = {
+    "RES-LEAK": ("never released or handed off on the fall-through path",
+                 "can raise before the release of",
+                 "_stamp_header() at server.py:",
+                 "JournalHazard._begin() at server.py:"),
+    "DET-TAINT": ("flows into byte sink",
+                  "settle order", "os.listdir() scan order",
+                  "_settled_tags() -> set() iteration order",
+                  "json.dump() serialization inside _write_summary()"),
+    "STATS-SCHEMA": ("is never serialized: summary()",
+                     "the workers/pipeline_depth drift class"),
+}
+
+
+def test_v3_rules_fire_and_match_golden_markers():
+    source = _fixture_source(FIXTURE_V3)
+    expected = _expected_markers(source)
+    findings = engine.check_source(VIRTUAL_DRIVER_PATH, source)
+    actual = {(f.rule, f.line) for f in findings if f.rule != "BAD-SUPPRESS"}
+    assert actual == expected, (
+        f"unexpected: {sorted(actual - expected)}; "
+        f"missing: {sorted(expected - actual)}")
+    fired = {rule for rule, _ in actual}
+    assert fired == V3_FIXTURE_RULES
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    for rule, pins in _V3_MESSAGE_PINS.items():
+        for pin in pins:
+            assert any(pin in m for m in by_rule.get(rule, [])), (
+                f"{rule}: no finding message contains {pin!r}")
+
+
+def test_v3_silenced_twins_are_suppressed_but_fire_raw():
+    source = _fixture_source(FIXTURE_V3)
+    silenced_lines = {
+        i + 1  # the standalone waiver targets the NEXT code line
+        for i, line in enumerate(source.splitlines(), start=1)
+        if "SILENCED" in line and "firacheck: allow[" in line
+    }
+    assert len(silenced_lines) >= 3, "v3 fixture lost its SILENCED twins"
+    suppressed = engine.check_source(VIRTUAL_DRIVER_PATH, source)
+    raw = engine.check_source(VIRTUAL_DRIVER_PATH, source, suppress=False)
+    suppressed_lines = {f.line for f in suppressed
+                        if f.rule != "BAD-SUPPRESS"}
+    raw_lines = {f.line for f in raw if f.rule != "BAD-SUPPRESS"}
+    for line in silenced_lines:
+        assert line not in suppressed_lines, (
+            f"waiver on line {line - 1} did not silence its finding")
+        assert line in raw_lines, (
+            f"SILENCED twin near line {line} stopped firing raw — the "
+            f"waiver now waives nothing")
+
+
+def test_v3_cross_function_leak_needs_the_call_graph():
+    """The corpus's cross-function hazards exist BECAUSE the v3 call
+    graph carries facts across frames: scanning the leaking caller with
+    its helper's body removed (the single-function view every v1/v2
+    rule has) must NOT produce the cross-function findings, while the
+    full corpus does."""
+    source = _fixture_source(FIXTURE_V3)
+    full = {(f.rule, f.line)
+            for f in engine.check_source(VIRTUAL_DRIVER_PATH, source)}
+    # strip the helper bodies the summaries walk: the raising fsync and
+    # the sinking json.dump become invisible
+    blinded = source.replace(
+        '    fh.write("header\\n")\n    os.fsync(fh.fileno())\n',
+        "    return None\n").replace(
+        '    with open(path, "w") as fh:\n        json.dump(payload, fh)\n',
+        "    return None\n")
+    assert blinded != source, "fixture helper bodies moved; update test"
+    blind = {(f.rule, f.line)
+             for f in engine.check_source(VIRTUAL_DRIVER_PATH, blinded)}
+    lost = full - blind
+    assert any(r == "RES-LEAK" for r, _ in lost), (
+        "cross-function RES-LEAK did not depend on the helper body")
+    assert any(r == "DET-TAINT" for r, _ in lost), (
+        "cross-function DET-TAINT did not depend on the helper body")
 
 
 def test_geometry_scope_is_package_segment_based(tmp_path):
@@ -317,7 +404,65 @@ def test_cli_json_output_and_rules_filter(capsys):
 def test_cli_rules_filter_rejects_unknown_rule(capsys):
     rc = firacheck_cli.main(["check", "--rules", "NOT-A-RULE", FIXTURE_V2])
     assert rc == 2
-    assert "unknown rule id" in capsys.readouterr().err
+    err = capsys.readouterr().err
+    assert "unknown rule id" in err and "'NOT-A-RULE'" in err
+    # the usage error must LIST the valid ids — the one place a user
+    # discovers a typo'd rule name without opening the registry
+    for rule in RULES:
+        assert rule in err, f"valid id {rule} missing from the error"
+
+
+def test_cli_sarif_output(tmp_path, capsys):
+    """--sarif writes a SARIF 2.1.0 log (the code-review interchange
+    envelope): schema/version pinned, every selected rule in the
+    driver's rules array whether or not it fired, one result per
+    finding with ruleId/level/message/physicalLocation."""
+    import json as json_lib
+
+    # the v3 corpus under a driver-suffixed path, so the driver-scoped
+    # rules arm for a real CLI invocation (same trick as
+    # VIRTUAL_DRIVER_PATH, realized on disk)
+    driver_copy = tmp_path / "fira_tpu" / "serve" / "server.py"
+    driver_copy.parent.mkdir(parents=True)
+    driver_copy.write_text(_fixture_source(FIXTURE_V3))
+    out = tmp_path / "v3.sarif"
+    rc = firacheck_cli.main(["check", "--quiet", "--sarif", str(out),
+                             "--rules", "RES-LEAK,DET-TAINT",
+                             "--no-suppress", str(driver_copy)])
+    capsys.readouterr()
+    assert rc == 1
+    doc = json_lib.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "firacheck"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    # the selection plus the always-gating meta rules, fired or not
+    assert rule_ids == {"RES-LEAK", "DET-TAINT", "BAD-SUPPRESS",
+                        "PARSE-ERROR"}
+    assert all(r["shortDescription"]["text"] == RULES[r["id"]]
+               for r in driver["rules"])
+    results = run["results"]
+    assert {r["ruleId"] for r in results} == {"RES-LEAK", "DET-TAINT"}
+    assert all(r["level"] in ("error", "warning") for r in results)
+    for r in results:
+        (loc,) = r["locations"]
+        phys = loc["physicalLocation"]
+        assert phys["artifactLocation"]["uri"].endswith(
+            "fira_tpu/serve/server.py")
+        assert phys["region"]["startLine"] >= 1
+        assert r["message"]["text"]
+    # the SARIF results mirror the engine's findings one-to-one
+    raw = engine.check_source(VIRTUAL_DRIVER_PATH,
+                              _fixture_source(FIXTURE_V3),
+                              suppress=False)
+    expected = {(f.rule, f.line) for f in raw
+                if f.rule in ("RES-LEAK", "DET-TAINT")}
+    got = {(r["ruleId"],
+            r["locations"][0]["physicalLocation"]["region"]["startLine"])
+           for r in results}
+    assert got == expected
 
 
 def test_driver_reg_fires_raw_on_v1_fixture_jit_corpus():
@@ -400,15 +545,43 @@ def test_docs_cover_every_rule():
         assert rule in doc, f"{rule} missing from docs/ANALYSIS.md"
 
 
-def test_repo_self_scan_is_clean():
-    """Tier-1 gate: the performance invariants hold over the whole repo
-    (modulo the committed, reasoned waiver baseline)."""
+@pytest.fixture(scope="module")
+def repo_scan():
+    """ONE full self-scan (v1+v2+v3, suppression folded) shared by the
+    tier-1 gate tests below — the scan is the expensive part, the
+    assertions are views over it."""
     paths = [os.path.join(REPO_ROOT, p)
              for p in ("fira_tpu", "tests", "scripts")]
-    findings = engine.check_paths(paths)
-    errors = [f.render() for f in findings
+    return engine.check_paths(paths)
+
+
+def test_repo_self_scan_is_clean(repo_scan):
+    """Tier-1 gate: the performance invariants hold over the whole repo
+    (modulo the committed, reasoned waiver baseline)."""
+    errors = [f.render() for f in repo_scan
               if f.severity is Severity.ERROR]
     assert not errors, "\n".join(errors)
+
+
+def test_repo_has_no_stale_waivers(repo_scan):
+    """Tier-1 gate: zero stale waivers. A ``# firacheck: allow[...]``
+    whose finding no longer fires is a lie in the source — the v1+v2+v3
+    scan (this fixture runs every family) must report no BAD-SUPPRESS,
+    so every committed waiver still waives a live raw finding and
+    carries a reason."""
+    stale = [f.render() for f in repo_scan if f.rule == "BAD-SUPPRESS"]
+    assert not stale, "\n".join(stale)
+
+
+def test_repo_v3_scan_is_warning_free(repo_scan):
+    """The v3 families hold repo-wide at ZERO findings — errors AND
+    warnings: every stats field is serialized, documented under docs/,
+    and backed; every resource window closes; no taint reaches a byte
+    sink unlaundered (modulo the reasoned waiver baseline, which the
+    stale-waiver gate keeps honest)."""
+    v3 = [f.render() for f in repo_scan
+          if f.rule in ("RES-LEAK", "DET-TAINT", "STATS-SCHEMA")]
+    assert not v3, "\n".join(v3)
 
 
 @pytest.mark.slow

@@ -120,9 +120,19 @@ class TestPerfKnobs:
         la, ga = jax.value_and_grad(loss(model))(params)
         lb, gb = jax.value_and_grad(loss(m2))(params)
         assert float(la) == float(lb)
+        # the loss is bit-equal but the grads are NOT guaranteed to be:
+        # remat-off re-derives the copy-head backward from a different
+        # XLA graph, and the compiler is free to reassociate f32 sums
+        # per graph. Bisected: max abs grad delta is single-digit-ulp
+        # noise (3.7e-9 under the default threefry lowering, 2.2e-8
+        # under the partitionable lowering mesh.py pins — the draws
+        # differ, the reassociation noise floor doesn't move in kind);
+        # atol=1e-7 stays ~4x above the observed floor and ~4 orders
+        # below any real backward change (a dropped remat term shifts
+        # grads at 1e-3+ on these magnitudes).
         jax.tree_util.tree_map(
-            lambda x, y: np.testing.assert_array_equal(
-                np.asarray(x), np.asarray(y)), ga, gb)
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=0, atol=1e-7), ga, gb)
 
 
 class TestSplitEncoderBuffer:

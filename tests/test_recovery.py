@@ -451,3 +451,30 @@ def test_cli_recovery_validation_exit2(tmp_path, capsys):
     assert "graphs only" in capsys.readouterr().err
     assert cli.main(dbase + ["--max-respawns", "2"]) == 2
     assert "graphs only" in capsys.readouterr().err
+
+
+def test_journal_begin_fsync_failure_closes_the_handle(tmp_path,
+                                                       monkeypatch):
+    """The firacheck RES-LEAK self-application: the begin-record fsync
+    can fail (full/dying disk) while no caller holds the half-built
+    Journal — __init__ must close the handle it just opened before
+    re-raising, or it strands until interpreter exit."""
+    import builtins
+
+    opened = []
+    real_open = builtins.open
+
+    def spy_open(*a, **k):
+        f = real_open(*a, **k)
+        opened.append(f)
+        return f
+
+    def full_disk(fd):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(builtins, "open", spy_open)
+    monkeypatch.setattr(recovery_lib.os, "fsync", full_disk)
+    with pytest.raises(OSError):
+        recovery_lib.Journal(str(tmp_path / "j.jsonl"), n=3,
+                             times=[0.0, 1.0, 2.0])
+    assert opened and all(f.closed for f in opened)

@@ -238,3 +238,97 @@ def test_thread_guard_feeder_ordered_channel_guarded():
         with Feeder(tasks, num_workers=3, depth=2, put=False) as feed:
             order = [item.index for item in feed]
     assert order == list(range(8))
+
+
+# ---------------------------------------------------------------------------
+# LeakGuard — the resource-lifecycle sanitizer (firacheck v3 runtime half)
+# ---------------------------------------------------------------------------
+
+
+def test_leak_guard_assert_clean_names_the_acquire_site():
+    with sanitizer.leak_guarding() as lg:
+        lg.note_acquire("block", "engine@0:7", what="paged block 7")
+        with pytest.raises(sanitizer.LeakError) as ei:
+            lg.assert_clean("test teardown")
+        msg = str(ei.value)
+        # the error carries the WHAT, the (kind, key), the acquire site
+        # (this file), and the discipline being enforced
+        assert "paged block 7" in msg
+        assert "block 'engine@0:7'" in msg
+        assert "test_sanitizer.py" in msg
+        assert "RES-LEAK discipline" in msg
+        lg.note_release("block", "engine@0:7")
+        lg.assert_clean("test teardown")  # balanced ledger passes
+        s = lg.summary()
+        assert s["acquires"] == 1 and s["releases"] == 1
+        assert s["open"] == 0 and s["unmatched_releases"] == 0
+
+
+def test_leak_guard_feeder_threads_check_in_and_out():
+    from fira_tpu.data.feeder import Feeder
+
+    tasks = ((lambda i=i: {"valid": np.ones(2, bool),
+                           "payload": np.full(3, i)}) for i in range(6))
+    with sanitizer.leak_guarding() as lg:
+        with Feeder(tasks, num_workers=2, depth=2, put=False) as feed:
+            order = [item.index for item in feed]
+        # close() joined every pipeline thread -> the ledger balances
+        lg.assert_clean("feeder teardown")
+        assert lg.summary()["acquires"] >= 2
+    assert order == list(range(6))
+
+
+def test_leak_guard_unjoined_thread_raises_at_teardown():
+    gate = threading.Event()
+    with sanitizer.leak_guarding() as lg:
+        t = threading.Thread(target=gate.wait, daemon=True)
+        t.start()
+        lg.track_thread(t, what="planted worker thread")
+        with pytest.raises(sanitizer.LeakError) as ei:
+            lg.assert_clean("planted teardown")
+        assert "planted worker thread" in str(ei.value)
+        gate.set()
+        t.join()
+        lg.note_joined(t)
+        lg.assert_clean("planted teardown")  # joined -> clean
+
+
+def test_leak_guard_watchdog_abandonment_is_sanctioned():
+    """A blown dispatch ABANDONS its daemon thread by design
+    (docs/FAULTS.md) — the ledger records the sanction instead of
+    calling it a leak at teardown."""
+    from fira_tpu.robust.watchdog import WatchdogTimeout, run_with_watchdog
+
+    release = threading.Event()
+    with sanitizer.leak_guarding() as lg:
+        with pytest.raises(WatchdogTimeout):
+            run_with_watchdog(release.wait, 0.05, label="test-hang")
+        lg.assert_clean("watchdog teardown")  # abandoned != leaked
+        s = lg.summary()
+        assert s["abandoned"] == 1 and s["open"] == 0
+    release.set()
+
+
+def test_leak_guard_unarmed_owners_carry_none_and_allocate_no_guard(
+        monkeypatch):
+    """The zero-overhead contract: unarmed, owners capture None at
+    construction and every acquire/release site is one is-None branch —
+    no LeakGuard (and no ledger) is ever allocated."""
+    from fira_tpu.data.feeder import Feeder
+
+    created = []
+    orig_init = sanitizer.LeakGuard.__init__
+
+    def spy(self, *a, **k):
+        created.append(self)
+        return orig_init(self, *a, **k)
+
+    monkeypatch.setattr(sanitizer.LeakGuard, "__init__", spy)
+    assert sanitizer.leak_guard() is None
+    tasks = ((lambda i=i: {"valid": np.ones(2, bool),
+                           "payload": np.full(3, i)}) for i in range(4))
+    with Feeder(tasks, num_workers=2, depth=2, put=False) as feed:
+        order = [item.index for item in feed]
+    assert order == list(range(4))
+    assert feed._leaks is None
+    assert not created

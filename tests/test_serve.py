@@ -396,3 +396,27 @@ def test_harvest_sliced_readback_metered(setup):
     assert st.harvest_bytes_saved > 0
     s = st.summary()
     assert s["harvest_bytes_saved"] == st.harvest_bytes_saved
+
+
+def test_serve_stats_serialize_completion_order_and_stable_heartbeats():
+    """The two firacheck v3 self-applications in ServeStats.summary():
+    ``completions`` (recorded since PR 11) must actually serialize
+    (STATS-SCHEMA), and ``heartbeats`` — a dict keyed by replica tag in
+    first-dispatch settle order — must serialize byte-identically
+    regardless of insertion order (DET-TAINT)."""
+    import json
+
+    from fira_tpu.serve.server import ServeStats
+
+    a = ServeStats(records=[])
+    a.completions = [4, 1, 3]
+    a.heartbeats["r1"] = {"round": 2, "dispatches": 7}
+    a.heartbeats["r0"] = {"round": 2, "dispatches": 9}
+    b = ServeStats(records=[])
+    b.completions = [4, 1, 3]
+    b.heartbeats["r0"] = {"round": 2, "dispatches": 9}
+    b.heartbeats["r1"] = {"round": 2, "dispatches": 7}
+    sa, sb = a.summary(), b.summary()
+    assert sa["completion_order"] == [4, 1, 3]
+    assert (json.dumps(sa["heartbeats"], sort_keys=False)
+            == json.dumps(sb["heartbeats"], sort_keys=False))
