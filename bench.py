@@ -125,6 +125,13 @@ batching engine vs the batched early-exit beam on the same 3-batch
 eos-biased stream — decode/engine.py; the watchdog harvest sets it),
 FIRA_BENCH_DECODE_EOS_DELTA (default 4.75 — the mixed-settle EOS bias of
 that leg's paramset),
+FIRA_BENCH_SPEC=1 (opt-in speculative-decode leg: draft-and-verify spec
+decode vs the plain engine twin at EQUAL geometry on the same 3-batch
+eos-biased stream — decode/spec.py, docs/DECODE_ENGINE.md "Speculative
+drafting" — per-position tokens asserted identical inside the leg;
+FIRA_BENCH_SPEC_TIER=draft|copy and FIRA_BENCH_SPEC_K pick the drafter;
+the full CPU artifact lands in docs/SPEC_BENCH_r01.jsonl via
+scripts/tpu_decode_bench.py),
 FIRA_BENCH_MULTICHIP=1 (opt-in multi-chip scaling leg: runs
 scripts/multichip_bench.py — grouped sharded train + replicated engine
 fleet at 1/2/4/8 virtual CPU devices, one fresh subprocess per count —
@@ -773,6 +780,86 @@ def worker() -> None:
             print(f"decode engine leg failed: {e!r}", file=sys.stderr)
             decode_engine = {"error": repr(e)}
 
+    # (e2) SPECULATIVE-DECODE leg (opt-in: FIRA_BENCH_SPEC=1): the
+    # draft-and-verify spec path (decode/spec.py) vs the plain engine
+    # twin at EQUAL geometry on the same 3-batch eos-biased stream,
+    # harvest cadence 1 on both sides so the comparison isolates
+    # speculation from cadence batching. Per-position tokens are asserted
+    # identical inside the leg — a speedup that costs output bytes is a
+    # bug, not a result. Protocol stays in lockstep with
+    # scripts/tpu_decode_bench.py's spec rows (docs/SPEC_BENCH_r01.jsonl).
+    spec = None
+    if os.environ.get("FIRA_BENCH_SPEC", "0") == "1":
+        try:
+            from fira_tpu.data.feeder import Feeder
+            from fira_tpu.decode import engine as engine_lib
+            from fira_tpu.decode.beam import eos_biased_params
+
+            eos_delta = float(os.environ.get(
+                "FIRA_BENCH_DECODE_EOS_DELTA", "4.75"))
+            spec_tier = os.environ.get("FIRA_BENCH_SPEC_TIER", "draft")
+            spec_k = int(os.environ.get("FIRA_BENCH_SPEC_K", "4"))
+            cfg_spec0 = cfg.replace(test_batch_size=batch_size,
+                                    beam_kv_cache=True,
+                                    beam_factored_topk=False,
+                                    decode_engine=True,
+                                    engine_harvest_every=1)
+            params_spec = eos_biased_params(state_box[0].params,
+                                            delta=eos_delta)
+            spec_chunks = [rng.choice(n_data, batch_size, replace=True)
+                           for _ in range(3)]
+
+            def spec_leg(cfg_leg):
+                model_leg = FiraModel(cfg_leg, dtype=jnp.dtype(dtype))
+                eng = engine_lib.SlotEngine(model_leg, params_spec, cfg_leg)
+
+                def drive(collect):
+                    tasks = ((lambda ix=ix: make_batch(split, ix, cfg_leg))
+                             for ix in spec_chunks)
+                    toks = {}
+                    with Feeder(tasks, num_workers=cfg.feeder_workers,
+                                depth=cfg.feeder_depth) as feed:
+                        for it in eng.run(feed):
+                            if collect:
+                                toks[it.position] = np.asarray(it.tokens)
+                    return toks
+
+                toks = drive(True)       # warm pass; tokens for the check
+                eng.stats = engine_lib.EngineStats(slots=eng.slots)
+                t0 = time.perf_counter()
+                drive(False)
+                dt = time.perf_counter() - t0
+                return toks, eng.stats.summary(), dt
+
+            toks_off, st_off, dt_off = spec_leg(cfg_spec0)
+            toks_on, st_on, dt_on = spec_leg(cfg_spec0.replace(
+                spec_decode=spec_tier, engine_spec_k=spec_k))
+            assert set(toks_on) == set(toks_off)
+            for p in toks_off:
+                np.testing.assert_array_equal(toks_on[p], toks_off[p])
+            spec = {
+                "tier": spec_tier,
+                "k": spec_k,
+                "eos_delta": eos_delta,
+                "tokens_identical": True,
+                "value_spec": round(st_on["commits"] / dt_on / n_chips, 2),
+                "value_plain": round(st_off["commits"] / dt_off / n_chips,
+                                     2),
+                "speedup": round((st_on["commits"] / dt_on)
+                                 / (st_off["commits"] / dt_off), 3),
+                "acceptance_rate": st_on["acceptance_rate"],
+                "drafted": st_on["drafted"],
+                "accepted": st_on["accepted"],
+                "verify_dispatches": st_on["verify_dispatches"],
+                "steps_saved": st_on["steps_saved"],
+                "spec_frames": st_on["spec_frames"],
+                "steps_per_commit_spec": st_on["steps_per_commit"],
+                "steps_per_commit_plain": st_off["steps_per_commit"],
+            }
+        except Exception as e:
+            print(f"spec decode leg failed: {e!r}", file=sys.stderr)
+            spec = {"error": repr(e)}
+
     # (f) MULTICHIP leg (opt-in: FIRA_BENCH_MULTICHIP=1): the composed
     # stack at 1/2/4/8 logical devices — sharded grouped train + the
     # replicated engine fleet — via scripts/multichip_bench.py (one fresh
@@ -930,6 +1017,10 @@ def worker() -> None:
         # slot-refill engine decode vs batched early exit on the same
         # stream (FIRA_BENCH_DECODE_ENGINE=1; decode/engine.py)
         **({"decode_engine": decode_engine} if decode_engine else {}),
+        # speculative draft-and-verify vs the plain engine twin at equal
+        # geometry (FIRA_BENCH_SPEC=1; decode/spec.py — the CPU artifact
+        # is docs/SPEC_BENCH_r01.jsonl via scripts/tpu_decode_bench.py)
+        **({"spec_decode": spec} if spec else {}),
         # multi-chip scaling rows (FIRA_BENCH_MULTICHIP=1; the full
         # artifact is MULTICHIP_r06.json — scripts/multichip_bench.py)
         **({"multichip": multichip} if multichip else {}),

@@ -120,6 +120,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "are invariant to N (pinned by tests). A nonzero "
                         "--engine-slots is the fleet TOTAL and must divide "
                         "by N")
+    p.add_argument("--spec-decode", default=None,
+                   choices=["off", "copy", "draft"],
+                   help="test/serve: speculative draft-and-verify decode "
+                        "on the slot engine (decode/spec.py, docs/"
+                        "DECODE_ENGINE.md 'Speculative drafting'): a "
+                        "cheap drafter proposes --spec-k tokens per live "
+                        "slot and ONE jitted verify program scores them "
+                        "with the engine's own step body, accepting the "
+                        "longest matching prefix. 'copy' drafts from the "
+                        "copy-head distribution alone (no decoder "
+                        "stack); 'draft' greedy-rolls the full step "
+                        "program. Accepted output stays bit-exact vs "
+                        "plain engine decode (pinned by tests); default "
+                        "off. Requires --engine")
+    p.add_argument("--spec-k", type=_positive, default=None, metavar="K",
+                   help="test/serve: speculative draft length — tokens "
+                        "proposed per slot per verify dispatch (default "
+                        "4). Must leave room in the smallest declared "
+                        "decode tar budget (validated at parse time, "
+                        "exit 2). Output bytes are invariant to K "
+                        "(pinned by tests)")
     p.add_argument("--kv-paged", default=None, choices=["on", "off"],
                    help="test: engine KV arena layout (docs/DECODE_ENGINE"
                         ".md 'Paged KV arena'): 'on' (default) pages the "
@@ -467,6 +488,10 @@ def _resolve_cfg(args):
         overrides["engine_harvest_every"] = args.engine_harvest_every
     if args.engine_replicas is not None:
         overrides["engine_replicas"] = args.engine_replicas
+    if args.spec_decode is not None:
+        overrides["spec_decode"] = args.spec_decode
+    if args.spec_k is not None:
+        overrides["engine_spec_k"] = args.spec_k
     if args.kv_paged is not None:
         overrides["engine_paged_kv"] = args.kv_paged == "on"
     if args.kv_block_size is not None:
@@ -707,6 +732,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     from fira_tpu.decode.paging import prefix_cache_errors
 
     errs += prefix_cache_errors(cfg)
+    # speculative-decode knob admission (tier name, draft length vs the
+    # smallest declared decode tar budget, engine path required) — same
+    # exit-2 contract, decode/spec.spec_errors; UNGATED for the same
+    # reason: `--spec-decode copy` without --engine names the missing
+    # knob instead of silently decoding plain
+    from fira_tpu.decode.spec import spec_errors
+
+    errs += spec_errors(cfg)
     if args.command == "serve":
         # serving knob admission (offered rate, prefill budget vs slots,
         # deadline floor, queue bound) — same exit-2 contract,

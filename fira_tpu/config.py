@@ -253,6 +253,31 @@ class FiraConfig:
     # file bytes are invariant to the replica count and to refill
     # interleaving (tests/test_fleet.py).
     engine_replicas: int = 1
+    # --- speculative draft-and-verify decode (decode/spec.py;
+    # docs/DECODE_ENGINE.md "Speculative drafting") ---
+    # "off" (default) | "copy" | "draft": arm draft-and-verify on the slot
+    # engine. A drafter proposes engine_spec_k tokens per live slot —
+    # "copy": the copy-head distribution alone, scored from the cached
+    # source projections against the raw target embedding (NO decoder
+    # stack — near-free, rides FIRA's verbatim-copy fraction); "draft": a
+    # greedy argmax roll of the existing cached step program on each
+    # slot's top beam only (1/beam of the step's decoder rows, scratch
+    # caches, real state untouched). ONE verify program then advances the
+    # exact one-step body per drafted position under a per-row accept
+    # gate (lax.while_loop — early-exits the dispatch once every row has
+    # diverged), so ACCEPTED output is bit-exact vs the plain engine BY
+    # CONSTRUCTION: every advanced position ran the identical step math,
+    # and rejected tails simply were never advanced (tests/test_spec.py
+    # pins tokens+probs+file bytes across kv x factored x paged modes,
+    # k, replica count, and harvest cadence). Default off: the plain f32
+    # non-spec path stays the byte-identical contract path.
+    spec_decode: str = "off"
+    # Drafted tokens per slot per verify dispatch (the (S, k) geometry of
+    # the engine_draft/engine_verify program family). Must be in
+    # [1, smallest declared decode tar budget - 1] and requires
+    # decode_engine (validated at parse time, exit 2 —
+    # decode/spec.spec_errors).
+    engine_spec_k: int = 4
 
     # --- online serving (serve/; docs/SERVING.md) ---
     # Offered load in requests/second for the open-loop Poisson arrival

@@ -80,6 +80,26 @@ def step_valid_mask(flat, s, T: int):
     return base & (jnp.arange(T)[None, :] <= lim)
 
 
+def top_beam_token(tokens, pos):
+    """Top-beam token at per-row position ``pos`` — the emitted token of
+    the step that just advanced row b to ``pos[b]`` (selection's top_k
+    returns candidates prob-descending, so beam 0 IS the running best
+    beam after every step). Shared by the slot engine's verify program
+    (decode/spec.py): a drafted token is accepted exactly when it equals
+    this value. tokens: (B, K, T); pos: (B,) int32 clamped by the caller
+    to a legal column."""
+    top = tokens[:, 0, :]
+    return jnp.take_along_axis(top, pos[:, None], axis=1)[:, 0]
+
+
+def scatter_token(flat, pos, tok):
+    """Write ``tok[b]`` at row b's own column ``pos[b]`` — the per-row
+    vector twin of :func:`_selection_tail`'s top-beam append, shared by
+    the spec drafters (decode/spec.py) rolling a single-beam prefix
+    forward. flat: (B, T) int32; pos/tok: (B,) int32."""
+    return flat.at[jnp.arange(flat.shape[0]), pos].set(tok)
+
+
 def _init_beam(B: int, cfg: FiraConfig):
     """Initial (tokens, probs, finished) carry + the masked/pad value."""
     K, T = cfg.beam_size, cfg.tar_len

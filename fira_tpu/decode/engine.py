@@ -129,6 +129,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import functools
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 import jax
@@ -139,6 +140,7 @@ from fira_tpu.analysis.sanitizer import leak_guard, program_label
 from fira_tpu.config import FiraConfig
 from fira_tpu.decode import paging
 from fira_tpu.decode import prefix_cache as prefix_cache_lib
+from fira_tpu.decode import spec as spec_lib
 from fira_tpu.decode.beam import (_init_beam, _select, _select_factored,
                                   step_valid_mask)
 from fira_tpu.model.model import FiraModel
@@ -192,12 +194,33 @@ class EngineStats:
     #                              (delivered by fan-out at harvest)
     shared_block_peak: int = 0   # high-water mark of paged blocks whose
     #                              seat serves a coalesced fan-out group
+    # speculative draft-and-verify accounting (decode/spec.py; all zero
+    # with cfg.spec_decode off — the byte-identical comparator). ``steps``
+    # counts a verify dispatch as ONE step — the forwards-per-token framing
+    # of the spec literature — so steps_per_commit falling under spec is
+    # exactly "fewer dispatches bought the same commits"; the device-side
+    # frames a verify actually ran are metered separately (spec_frames):
+    # on CPU each frame costs one plain step's FLOPs, on a parallel-verify
+    # backend it does not.
+    drafted: int = 0             # draft tokens proposed (k x live slots
+    #                              at verify entry)
+    accepted: int = 0            # drafted tokens the verify frames matched
+    verify_dispatches: int = 0   # draft->verify dispatches (vs plain steps)
+    steps_saved: int = 0         # beam frames a verify advanced BEYOND its
+    #                              frame-0 obligation — plain step
+    #                              dispatches' worth of work avoided
+    spec_frames: int = 0         # verify while_loop frames actually run
 
     @property
     def cache_hit_rate(self) -> float:
         """Fraction of seated rows served from the prefill cache."""
         total = self.cache_hits + self.cache_misses
         return self.cache_hits / total if total else 0.0
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Fraction of drafted tokens the verify frames accepted."""
+        return self.accepted / self.drafted if self.drafted else 0.0
 
     @property
     def slot_occupancy(self) -> float:
@@ -253,6 +276,12 @@ class EngineStats:
             "cache_hbm_bytes_saved": self.cache_hbm_bytes_saved,
             "dedup_fanout": self.dedup_fanout,
             "shared_block_peak": self.shared_block_peak,
+            "drafted": self.drafted,
+            "accepted": self.accepted,
+            "acceptance_rate": round(self.acceptance_rate, 4),
+            "verify_dispatches": self.verify_dispatches,
+            "steps_saved": self.steps_saved,
+            "spec_frames": self.spec_frames,
         }
 
 
@@ -371,6 +400,27 @@ class SlotEngine:
             jax.lax.dynamic_index_in_dim(tokens, slot, 0, keepdims=False),
             jax.lax.dynamic_index_in_dim(probs, slot, 0, keepdims=False)))
         self._pending_occ = None
+        # speculative draft-and-verify (decode/spec.py; cfg.spec_decode):
+        # the drafter reads the arena (never donated — the verify right
+        # behind it consumes the same state), the verify donates it like
+        # the plain step. _pending_spec carries the verify's device-side
+        # [tested, matched, iters] counters to the harvest sync boundary
+        # (the _pending_occ pattern: no new host syncs). _spec_cd is the
+        # stall cooldown — plain dispatches to run before re-arming after
+        # a verify whose drafts all missed (scheduling only; output bytes
+        # are invariant by the spec.py exactness argument).
+        self._spec_tier = (cfg.spec_decode
+                           if cfg.spec_decode not in (None, "off") else None)
+        self._spec_k = int(cfg.engine_spec_k)
+        self._spec_cd = 0
+        self._pending_spec = None
+        if self._spec_tier is not None:
+            errs = spec_lib.spec_errors(cfg)
+            if errs:
+                raise ValueError("; ".join(errs))
+            self._draft = jax.jit(
+                spec_lib.make_drafter(model, cfg, self.slots, self._paged))
+            self._verify = jax.jit(self._verify_fn, donate_argnums=(1,))
         self.begin_stream()
 
     def label(self, kind: str, geom_tag: Optional[str] = None) -> str:
@@ -391,7 +441,7 @@ class SlotEngine:
         prefills = ([self.label(PREFILL_KIND, geom_tag(g)) for g in table]
                     if table is not None else [self.label(PREFILL_KIND)])
         return prefills + [self.label(STEP_LABEL), self.label(INSERT_LABEL),
-                           self.label(HARVEST_LABEL)]
+                           self.label(HARVEST_LABEL)] + self._spec_labels()
 
     def labels_for_tags(self, geom_tags) -> List[str]:
         """The declared family from already-computed geometry tags (the
@@ -402,7 +452,18 @@ class SlotEngine:
         prefills = [self.label(PREFILL_KIND, t) for t in geom_tags] \
             or [self.label(PREFILL_KIND)]
         return prefills + [self.label(STEP_LABEL), self.label(INSERT_LABEL),
-                           self.label(HARVEST_LABEL)]
+                           self.label(HARVEST_LABEL)] + self._spec_labels()
+
+    def _spec_labels(self) -> List[str]:
+        """The (S, k) draft/verify pair when spec is armed (the ``k<k>``
+        geometry mod composes with the replica tag —
+        ``engine_verify[k4.r1]``); empty with cfg.spec_decode off, so the
+        non-spec declared family is byte-for-byte unchanged."""
+        if self._spec_tier is None:
+            return []
+        km = f"k{self._spec_k}"
+        return [self.label(spec_lib.DRAFT_LABEL, km),
+                self.label(spec_lib.VERIFY_LABEL, km)]
 
     # --- jitted programs -------------------------------------------------
 
@@ -453,8 +514,27 @@ class SlotEngine:
             body, (state, jnp.int32(0)), None, length=R)
         return state, occ
 
-    def _one_step(self, params, state):
-        """One beam position for every live, not-yet-done slot."""
+    def _verify_fn(self, params, state, drafts):
+        """The speculative verify program: up to ``engine_spec_k`` gated
+        EXACT step frames in one dispatch (decode/spec.run_verify over
+        this engine's own :meth:`_one_step` — the identical per-position
+        HLO the plain step runs, which is the whole exactness argument).
+        Returns (state', occ_entry, [tested, matched, iters]); occ_entry
+        rides the _pending_occ slot, the counter vector _pending_spec."""
+        step = functools.partial(self._one_step, params)
+        return spec_lib.run_verify(step, state, drafts, self._spec_k,
+                                   self.cfg.tar_len)
+
+    def _one_step(self, params, state, gate=None):
+        """One beam position for every live, not-yet-done slot.
+
+        ``gate`` (None on every plain path — the trace is unchanged): a
+        (S,) bool the spec verify program (decode/spec.py) ANDs into the
+        active mask, freezing rows whose drafts already diverged. A frozen
+        row is handled by the inactive-row discipline that already exists
+        for idle/done slots — blended state, sentinel-masked paged table —
+        with ONE extra care: the unpaged cache permute below must not
+        scribble a row that will RESUME (see the gated identity blend)."""
         cfg, model = self.cfg, self.model
         S, K, T = self.slots, cfg.beam_size, cfg.tar_len
         L, H = cfg.num_layers, cfg.num_head
@@ -466,6 +546,8 @@ class SlotEngine:
                                    state["finished"])
         pos = state["pos"]
         active = state["live"] & ~state["done"]
+        if gate is not None:
+            active = active & gate
         # idle/done rows clamp to a legal position; their computation is
         # garbage by construction and blended away below
         pos_c = jnp.minimum(pos, T - 2)
@@ -570,6 +652,16 @@ class SlotEngine:
             # step scribble on it saves two full-cache select passes per
             # micro-step. tokens/probs/finished/pos DO blend below: they
             # must survive until harvest.
+            #
+            # GATED mode is the one exception: a verify-frozen row RESUMES
+            # — permuting its cache by this frame's garbage src_beam would
+            # hand the resumed step a shuffled history. Frozen rows get
+            # the identity permutation instead (their cache bytes pass
+            # through the gather unchanged); the plain trace (gate=None)
+            # keeps the cheaper scribble, byte-for-byte as before.
+            if gate is not None:
+                src_beam = jnp.where(active[:, None], src_beam,
+                                     jnp.arange(K)[None, :])
             idx = src_beam[None, :, :, None, None, None]
 
             def gather_cache(c):
@@ -771,6 +863,19 @@ class SlotEngine:
         self._take_rows(self._state["tokens"], self._state["probs"],
                         jnp.int32(0))
         self._guard_step(self.label(HARVEST_LABEL))
+        if self._spec_tier is not None:
+            # compile the (S, k) draft/verify pair over the all-dead arena:
+            # the verify's while_loop condition is false at frame 0 (no
+            # live row), so the state passes through unchanged — but both
+            # programs compile here, not inside a watchdogged dispatch
+            km = f"k{self._spec_k}"
+            drafts = self._draft(self.params, self._state)
+            self._guard_step(self.label(spec_lib.DRAFT_LABEL, km))
+            self._state, occ, pend = self._verify(self.params, self._state,
+                                                  drafts)
+            self._guard_step(self.label(spec_lib.VERIFY_LABEL, km))
+            self._pending_occ = occ      # zeros: no slot was active
+            self._pending_spec = pend
 
     # --- steppable scheduler pieces (the fleet round-robins these) -------
 
@@ -1227,17 +1332,43 @@ class SlotEngine:
             self._faults.check("engine.step")
         if self.retired:
             return  # abandoned by a watchdog mid-dispatch; engine is dead
-        new_state, new_occ = self._step(self.params, self._state)
+        # speculative draft->verify->accept replaces the harvest-cadence
+        # scan when armed and not cooling down after an acceptance stall
+        # (decode/spec.py): the drafter reads the arena, the verify donates
+        # it exactly like the plain step. Either program family member
+        # advances every live slot at least one frame, so the
+        # step->harvest cadence contract is unchanged.
+        spec_now = self._spec_tier is not None and self._spec_cd == 0
+        if spec_now:
+            drafts = self._draft(self.params, self._state)
+            new_state, new_occ, new_spec = self._verify(
+                self.params, self._state, drafts)
+        else:
+            new_state, new_occ = self._step(self.params, self._state)
+            new_spec = None
         if self.retired:
             # the watchdog expired while the dispatch call was in flight:
             # do NOT touch the shared compile guard or stats from this
             # abandoned thread — the live loop owns them now
             return
         self._state, self._pending_occ = new_state, new_occ
-        self._guard_step(self.label(STEP_LABEL))
+        self._pending_spec = new_spec
+        if self._spec_cd > 0:
+            self._spec_cd -= 1
         st = self.stats
+        if spec_now:
+            km = f"k{self._spec_k}"
+            self._guard_step(self.label(spec_lib.DRAFT_LABEL, km))
+            self._guard_step(self.label(spec_lib.VERIFY_LABEL, km))
+            # ONE step: the forwards-per-token accounting (see EngineStats)
+            # — the frames the verify actually ran land in spec_frames at
+            # harvest, where the device counters are drained
+            st.steps += 1
+            st.verify_dispatches += 1
+        else:
+            self._guard_step(self.label(STEP_LABEL))
+            st.steps += max(1, int(self.cfg.engine_harvest_every))
         st.step_dispatches += 1
-        st.steps += max(1, int(self.cfg.engine_harvest_every))
         # pool accounting, re-stamped every dispatch so the bench's stats
         # resets between timed windows keep the HBM fields populated
         st.pool_blocks = self._pool_blocks
@@ -1282,8 +1413,29 @@ class SlotEngine:
             # either the in-flight leader or the cached artifacts
             self._drain_pending_fills()
         stats = self.stats
-        stats.occupied_slot_steps += int(np.array(
-            jax.device_get(self._pending_occ)))
+        occ_now = int(np.array(jax.device_get(self._pending_occ)))
+        stats.occupied_slot_steps += occ_now
+        if self._pending_spec is not None:
+            # drain the verify's device counters at the SAME sync boundary
+            # the occupancy/done readbacks already pay — spec metering
+            # adds no host sync of its own (decode/spec.run_verify)
+            tested, matched, iters = (
+                int(x) for x in np.array(jax.device_get(self._pending_spec)))
+            if self.retired:
+                # the counter readback is a sync window a watchdog expiry
+                # can abandon this thread inside; survivors own the
+                # engine's scheduling state now — touch nothing
+                return []
+            self._pending_spec = None
+            stats.drafted += self._spec_k * occ_now
+            stats.accepted += matched
+            stats.steps_saved += tested - occ_now
+            stats.spec_frames += iters
+            if occ_now and matched == 0:
+                # acceptance stalled (a rare-token span the drafter cannot
+                # see): run a few plain dispatches before re-arming, so a
+                # cold stretch does not pay draft+verify per emitted token
+                self._spec_cd = spec_lib.STALL_COOLDOWN
         done = np.array(jax.device_get(self._state["done"]))
         newly = [s for s in self._busy if done[s]]
         items: List[EngineItem] = []

@@ -339,6 +339,21 @@ def gather_block_kv(pool_l: jnp.ndarray, block_tab: jnp.ndarray
     return blocks.reshape(S * K, H, W * BS, d_head)
 
 
+def gather_block_kv_beam(pool_l: jnp.ndarray, block_tab: jnp.ndarray,
+                         beam: int) -> jnp.ndarray:
+    """One BEAM LANE's dense cache view from the paged pool: the
+    (S, H, W*BS, d_head) slice of :func:`gather_block_kv` at beam lane
+    ``beam``, gathered without materializing the other K-1 lanes. The
+    speculative draft-tier roll (decode/spec.py) copies the top-beam lane
+    into a dense scratch cache once per draft and rolls on that — the
+    pool itself is never written by a drafter."""
+    P, K, H, BS, d_head = pool_l.shape
+    S, W = block_tab.shape
+    blocks = pool_l[:, beam][block_tab]             # (S, W, H, BS, dh)
+    blocks = blocks.transpose(0, 2, 1, 3, 4)        # (S, H, W, BS, dh)
+    return blocks.reshape(S, H, W * BS, d_head)
+
+
 def append_block_kv(pool: jnp.ndarray, layer: int, blk: jnp.ndarray,
                     krow: jnp.ndarray, off: jnp.ndarray, new: jnp.ndarray
                     ) -> jnp.ndarray:

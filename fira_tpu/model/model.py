@@ -314,6 +314,20 @@ class Decoder(nn.Module):
             x = getattr(self, f"ffn_{i}")(x, deterministic=True)
         return x, k_cache, v_cache
 
+    def embed_at(self, tok, pos_idx):
+        """Decoder INPUT stem at per-row positions — token embedding plus
+        the positional row, k-position capable: ``tok`` (B, n) with
+        ``pos_idx`` (B, n) embeds n positions per row at once; the cached
+        step paths call it at n=1 with a (B,) vector. Exposed on its own
+        so the speculative copy drafter (decode/spec.py) can score the
+        copy head against the raw target embedding WITHOUT running any
+        decoder layer."""
+        pos = pos_idx.astype(jnp.int32)
+        table = self._pos_table()[pos]
+        if table.ndim == 2:            # (B,) positions -> (B, 1, D) rows
+            table = table[:, None, :]
+        return self.embed(tok) + table
+
     def decode_step_multi(self, tok, pos_idx, k_cache, v_cache, cross_k,
                           cross_v, sou_mask, self_mask):
         """One cached decode position PER ROW: like :meth:`decode_step` but
@@ -327,7 +341,7 @@ class Decoder(nn.Module):
         B = tok.shape[0]
         pos = pos_idx.astype(jnp.int32)
         b_idx = jnp.arange(B)
-        x = self.embed(tok) + self._pos_table()[pos][:, None, :]
+        x = self.embed_at(tok, pos)
         for i in range(self.cfg.num_layers):
             sa = getattr(self, f"self_attn_{i}")
             k_new, v_new = sa.project_kv(x, x)       # (B, H, 1, d_head)
@@ -585,6 +599,22 @@ class FiraModel(nn.Module):
         step x beam (run_model.py:256-259)."""
         cross_k, cross_v = self.decoder.cross_kv(states)
         return cross_k, cross_v, self.copy_net.project_src(states)
+
+    def copy_draft_scores(self, mask, src_proj, tok, pos_idx):
+        """Speculative COPY drafter head (decode/spec.py, tier ``copy``):
+        the pointer scores ALONE against the raw target-embedding proxy
+        ``Decoder.embed_at(tok, pos_idx)`` — no decoder layer runs and no
+        cache is touched, so a k-token draft roll costs k embedding rows
+        plus k copy-score passes. Scores get the same source-validity mask
+        as :meth:`_step_heads`; the drafter argmaxes them into copy-space
+        proposals (``vocab_size +`` source position). Draft quality only
+        moves the acceptance rate — never output bytes (the verify program
+        is the exact step body) — so the proxy target is deliberately
+        cheap."""
+        x = self.decoder.embed_at(tok, pos_idx)
+        scores, _gate = self.copy_net.score_gate(src_proj, x)
+        return jnp.where(mask[:, None, :], scores,
+                         jnp.asarray(-1e9, scores.dtype))
 
     def dist_parts(self, states, mask, tar, tar_mask_pad, *,
                    deterministic: bool = True):

@@ -164,6 +164,19 @@ class FleetStats:
             "cache_hbm_bytes_saved": tot("cache_hbm_bytes_saved"),
             "dedup_fanout": tot("dedup_fanout"),
             "shared_block_peak": tot("shared_block_peak"),
+            # speculative draft-and-verify accounting (decode/spec.py):
+            # drafters are per-replica, so counts total across the fleet
+            # and the acceptance rate is the fleet-wide accepted fraction;
+            # per-replica rates ride alongside like occupancy does
+            "drafted": tot("drafted"),
+            "accepted": tot("accepted"),
+            "acceptance_rate": round(tot("accepted") / tot("drafted"), 4)
+            if tot("drafted") else 0.0,
+            "verify_dispatches": tot("verify_dispatches"),
+            "steps_saved": tot("steps_saved"),
+            "spec_frames": tot("spec_frames"),
+            "per_replica_acceptance": [
+                round(r.acceptance_rate, 4) for r in self.replicas],
             # fleet-wide mean fraction of slots doing real beam work
             "slot_occupancy": round(
                 tot("occupied_slot_steps") / steps_x_slots, 4

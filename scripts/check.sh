@@ -15,7 +15,7 @@ echo "== firacheck: static JAX-hazard scan =="
 # fira_tpu/data/feeder.py, fira_tpu/data/buckets.py,
 # fira_tpu/data/grouping.py, fira_tpu/decode/engine.py,
 # fira_tpu/decode/paging.py, fira_tpu/decode/prefix_cache.py,
-# fira_tpu/parallel/fleet.py,
+# fira_tpu/decode/spec.py, fira_tpu/parallel/fleet.py,
 # fira_tpu/serve/server.py, fira_tpu/ingest/difftext.py,
 # fira_tpu/ingest/service.py, fira_tpu/ingest/cache.py,
 # fira_tpu/robust/faults.py,
@@ -23,7 +23,8 @@ echo "== firacheck: static JAX-hazard scan =="
 # train loop/step factories, the beam/engine decode drivers, the async
 # input pipeline, the bucket packer, the grouped dispatch scheduler,
 # the slot-refill decode engine, the paged-KV arena
-# geometry/validation, the cross-request prefix cache, the replicated
+# geometry/validation, the cross-request prefix cache, the speculative
+# draft-and-verify decode programs, the replicated
 # decode fleet, the arrival-timed serving loop, the raw-diff ingest
 # pipeline (+ its whole-diff result cache / hunk memo / process
 # executor) and the fault-injection/watchdog/recovery machinery. Their
@@ -37,7 +38,7 @@ JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu/data/feeder.py fira_tpu/data/buckets.py \
     fira_tpu/data/grouping.py fira_tpu/decode/engine.py \
     fira_tpu/decode/paging.py fira_tpu/decode/prefix_cache.py \
-    fira_tpu/parallel/fleet.py \
+    fira_tpu/decode/spec.py fira_tpu/parallel/fleet.py \
     fira_tpu/serve/server.py fira_tpu/ingest/difftext.py \
     fira_tpu/ingest/service.py fira_tpu/ingest/cache.py \
     fira_tpu/robust/faults.py \
@@ -121,6 +122,18 @@ echo "== ingest-cache smoke: duplicate diff trace, cache on == cache off (docs/I
 # compiles (the cache is pure host work in front of declared
 # geometries; no new program exists).
 JAX_PLATFORMS=cpu python scripts/serve_bench.py --ingest-cache-smoke || exit $?
+
+echo "== spec smoke: spec-on serve == plain drain bytes (docs/DECODE_ENGINE.md 'Speculative drafting') =="
+# Speculative draft-and-verify stays exact in tier-1: a spec-armed
+# serve (draft tier, k=4) replayed under the armed compile guard must
+# produce output BYTES identical to the plain spec-off drain with REAL
+# acceptances metered (accepted > 0, verify_dispatches > 0 — a run
+# where speculation never engaged proves nothing) and zero post-warmup
+# compiles from the declared draft/verify program family; then a seeded
+# engine.step fault on a 2-replica spec-armed fleet must still fire,
+# retire the replica, requeue onto the survivor, and serve the same
+# bytes — speculation must not widen the fault blast radius.
+JAX_PLATFORMS=cpu python scripts/serve_bench.py --spec-smoke || exit $?
 
 echo "== chaos smoke: seeded fault at each site (docs/FAULTS.md) =="
 # The graceful-degradation contracts stay machine-enforced in tier-1:

@@ -80,6 +80,16 @@ Modes:
               partial hits recorded, and zero post-warmup retraces (the
               check.sh leg of the ingest fast-path bit-exactness
               contract). Exit nonzero on any violation.
+  --spec-smoke
+              speculative draft-and-verify leg (docs/DECODE_ENGINE.md
+              "Speculative drafting"): a spec-armed serve (draft tier,
+              k=4) under the armed compile guard must produce bytes
+              identical to the plain spec-off drain with REAL
+              acceptances metered and zero post-warmup compiles, and a
+              seeded engine.step fault on a 2-replica spec-armed fleet
+              must still retire/requeue byte-identically (the check.sh
+              leg of the speculative-decode equivalence contract). Exit
+              nonzero on any violation.
 
 Env knobs: FIRA_SERVE_COMMITS (synthetic corpus size, default 600),
 FIRA_SERVE_RATE_FRACS (default "0.25,0.5,0.8,1.2,1.6" x drain capacity),
@@ -1059,6 +1069,83 @@ def smoke() -> int:
     return 0 if ok else 1
 
 
+def spec_smoke() -> int:
+    """Speculative draft-and-verify equivalence leg (scripts/check.sh,
+    docs/DECODE_ENGINE.md "Speculative drafting"): a spec-armed serve
+    under the armed compile guard must produce BYTE-IDENTICAL output to
+    the plain drain, with REAL acceptances recorded (accepted > 0,
+    verify_dispatches > 0 — a run where speculation never engaged proves
+    nothing) and zero post-warmup compiles. Then the chaos-compat leg:
+    an engine.step fault on a 2-replica fleet with spec ARMED must still
+    fire, retire the faulted replica, requeue its work onto the
+    survivor, and serve the same bytes — speculation must not widen the
+    fault blast radius or break the retire/requeue path."""
+    import dataclasses
+
+    from fira_tpu.analysis import sanitizer
+    from fira_tpu.decode.runner import run_test
+    from fira_tpu.robust import faults as faults_lib
+    from fira_tpu.serve import poisson_times, serve_split
+
+    dataset, _corpus, cfg, model, params = _setup(
+        40, batch=6, slots=6, eos_delta=4.0, buckets=((16, 400, 12),))
+    n = len(dataset.splits["train"])
+    times = poisson_times(n, rate=0.5, seed=3)  # virtual-clock units
+    work = tempfile.mkdtemp(prefix="fira_spec_smoke_")
+
+    scfg = dataclasses.replace(cfg, spec_decode="draft", engine_spec_k=4)
+
+    # --- equivalence leg: spec-on serve vs spec-off drain, same stream
+    drain = run_test(model, params, dataset, cfg,
+                     out_dir=os.path.join(work, "plain"), split="train")
+    ref = open(drain["output_path"], "rb").read()
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        m = serve_split(model, params, dataset, scfg, arrival_times=times,
+                        out_dir=os.path.join(work, "spec"), split="train",
+                        clock="virtual", guard=guard)
+        extra = guard.compiles_after_warmup()
+    got = open(m["output_path"], "rb").read()
+    e, sv = m["engine"], m["serve"]
+
+    # --- chaos-compat leg: same spec config, 2 replicas, a seeded
+    # engine.step fault that FIRES on this schedule. Output must still
+    # match the plain drain bytes exactly (per-row exactness holds
+    # through retire + requeue) with the retirement recorded.
+    ccfg = dataclasses.replace(scfg, engine_replicas=2, engine_slots=12,
+                               inject_faults="engine.step:raise:0.02:18")
+    inj = faults_lib.injector_from(ccfg)
+    with sanitizer.sanitize(nans=False, infs=False) as guard2:
+        m2 = serve_split(model, params, dataset, ccfg, arrival_times=times,
+                         out_dir=os.path.join(work, "fleet_faulted"),
+                         split="train", clock="virtual", guard=guard2,
+                         faults=inj)
+        extra2 = guard2.compiles_after_warmup()
+    sv2 = m2["serve"]
+    fleet_got = open(m2["output_path"], "rb").read()
+    fired = sum(m2.get("faults", {}).values())
+
+    ok = (got == ref and extra == 0 and sv["completed"] == n
+          and e["accepted"] > 0 and e["verify_dispatches"] > 0
+          and fleet_got == ref and fired > 0 and sv2["completed"] == n
+          and sv2["replica_retirements"] >= 1 and extra2 == 0)
+    print(json.dumps({
+        "smoke": "ok" if ok else "FAIL",
+        "bytes_equal_plain": got == ref,
+        "compiles_after_warmup": extra,
+        "completed": sv["completed"], "offered": n,
+        "acceptance_rate": e["acceptance_rate"],
+        "accepted": e["accepted"],
+        "verify_dispatches": e["verify_dispatches"],
+        "steps_saved": e["steps_saved"],
+        "chaos_bytes_equal_plain": fleet_got == ref,
+        "chaos_compiles_after_warmup": extra2,
+        "chaos_faults_fired": fired,
+        "chaos_completed": sv2["completed"],
+        "chaos_replica_retirements": sv2["replica_retirements"],
+    }), flush=True)
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -1078,6 +1165,10 @@ def main() -> int:
     ap.add_argument("--ingest-cache-smoke", action="store_true",
                     help="duplicate diff trace, ingest-cache on == off "
                          "bytes with real hits leg (scripts/check.sh)")
+    ap.add_argument("--spec-smoke", action="store_true",
+                    help="speculative decode: spec-on serve bytes == "
+                         "plain drain bytes with real acceptances, plus "
+                         "the fault-under-spec fleet leg (scripts/check.sh)")
     ap.add_argument("--out", default=None,
                     help=f"JSONL record path (default {DEFAULT_OUT}; "
                          f"{DEFAULT_CACHE_OUT} with --cache; "
@@ -1095,6 +1186,8 @@ def main() -> int:
         return ingest_smoke()
     if args.ingest_cache_smoke:
         return ingest_cache_smoke()
+    if args.spec_smoke:
+        return spec_smoke()
     if args.cache:
         return cache_measure(args.out or DEFAULT_CACHE_OUT)
     if args.ingest:
