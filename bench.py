@@ -132,6 +132,15 @@ drafting" — per-position tokens asserted identical inside the leg;
 FIRA_BENCH_SPEC_TIER=draft|copy and FIRA_BENCH_SPEC_K pick the drafter;
 the full CPU artifact lands in docs/SPEC_BENCH_r01.jsonl via
 scripts/tpu_decode_bench.py),
+FIRA_BENCH_QUANT=1 (opt-in low-precision-tier leg: the bf16 KV arena +
+int8 weight tier vs the f32 engine twin at EQUAL geometry on the same
+3-batch eos-biased stream — decode/quant.py, docs/DECODE_ENGINE.md
+"Low-precision tiers" — token-match fraction RECORDED, not asserted:
+cross-tier drift is the quantity under test, next to the machine-
+recorded kv_bytes_per_slot halving; FIRA_BENCH_QUANT_KV=f32|bf16 and
+FIRA_BENCH_QUANT_PRECISION=f32|bf16|int8w pick the tier; the full CPU
+artifact lands in docs/QUANT_BENCH_r01.jsonl via
+scripts/serve_bench.py --quant),
 FIRA_BENCH_MULTICHIP=1 (opt-in multi-chip scaling leg: runs
 scripts/multichip_bench.py — grouped sharded train + replicated engine
 fleet at 1/2/4/8 virtual CPU devices, one fresh subprocess per count —
@@ -860,6 +869,78 @@ def worker() -> None:
             print(f"spec decode leg failed: {e!r}", file=sys.stderr)
             spec = {"error": repr(e)}
 
+    # (e3) LOW-PRECISION-TIER leg (opt-in: FIRA_BENCH_QUANT=1): the bf16
+    # KV arena + int8 weight tier (decode/quant.py) vs the plain f32
+    # engine twin at EQUAL geometry on the same 3-batch eos-biased
+    # stream. Quality is MEASURED, never assumed: the leg records the
+    # token-match fraction vs f32 instead of asserting identity (cross-
+    # tier drift is the quantity under test), next to the machine-
+    # recorded kv_bytes_per_slot halving. The full CPU artifact is
+    # docs/QUANT_BENCH_r01.jsonl via scripts/serve_bench.py --quant.
+    quant_leg = None
+    if os.environ.get("FIRA_BENCH_QUANT", "0") == "1":
+        try:
+            from fira_tpu.data.feeder import Feeder
+            from fira_tpu.decode import engine as engine_lib
+            from fira_tpu.decode.beam import eos_biased_params
+
+            eos_delta = float(os.environ.get(
+                "FIRA_BENCH_DECODE_EOS_DELTA", "4.75"))
+            q_kv = os.environ.get("FIRA_BENCH_QUANT_KV", "bf16")
+            q_sp = os.environ.get("FIRA_BENCH_QUANT_PRECISION", "int8w")
+            cfg_q0 = cfg.replace(test_batch_size=batch_size,
+                                 beam_kv_cache=True,
+                                 beam_factored_topk=False,
+                                 decode_engine=True,
+                                 engine_harvest_every=1)
+            params_q = eos_biased_params(state_box[0].params,
+                                         delta=eos_delta)
+            q_chunks = [rng.choice(n_data, batch_size, replace=True)
+                        for _ in range(3)]
+
+            def quant_run(cfg_leg):
+                model_leg = FiraModel(cfg_leg, dtype=jnp.dtype(dtype))
+                eng = engine_lib.SlotEngine(model_leg, params_q, cfg_leg)
+
+                def drive(collect):
+                    tasks = ((lambda ix=ix: make_batch(split, ix, cfg_leg))
+                             for ix in q_chunks)
+                    toks = {}
+                    with Feeder(tasks, num_workers=cfg.feeder_workers,
+                                depth=cfg.feeder_depth) as feed:
+                        for it in eng.run(feed):
+                            if collect:
+                                toks[it.position] = np.asarray(it.tokens)
+                    return toks
+
+                toks = drive(True)       # warm pass; tokens for the match
+                eng.stats = engine_lib.EngineStats(slots=eng.slots)
+                t0 = time.perf_counter()
+                drive(False)
+                dt = time.perf_counter() - t0
+                return toks, eng.stats.summary(), dt
+
+            toks_f32, st_f32, dt_f32 = quant_run(cfg_q0)
+            toks_q, st_q, dt_q = quant_run(cfg_q0.replace(
+                kv_dtype=q_kv, serve_precision=q_sp))
+            match = sum(bool(np.array_equal(toks_q[p], toks_f32[p]))
+                        for p in toks_f32)
+            quant_leg = {
+                "kv_dtype": st_q["kv_dtype"],
+                "serve_precision": st_q["serve_precision"],
+                "eos_delta": eos_delta,
+                "value_quant": round(st_q["commits"] / dt_q / n_chips, 2),
+                "value_f32": round(st_f32["commits"] / dt_f32 / n_chips, 2),
+                "speedup": round((st_q["commits"] / dt_q)
+                                 / (st_f32["commits"] / dt_f32), 3),
+                "kv_bytes_per_slot_f32": st_f32["kv_bytes_per_slot"],
+                "kv_bytes_per_slot_tier": st_q["kv_bytes_per_slot"],
+                "token_match_frac": round(match / max(1, len(toks_f32)), 3),
+            }
+        except Exception as e:
+            print(f"quant tier leg failed: {e!r}", file=sys.stderr)
+            quant_leg = {"error": repr(e)}
+
     # (f) MULTICHIP leg (opt-in: FIRA_BENCH_MULTICHIP=1): the composed
     # stack at 1/2/4/8 logical devices — sharded grouped train + the
     # replicated engine fleet — via scripts/multichip_bench.py (one fresh
@@ -1021,6 +1102,11 @@ def worker() -> None:
         # geometry (FIRA_BENCH_SPEC=1; decode/spec.py — the CPU artifact
         # is docs/SPEC_BENCH_r01.jsonl via scripts/tpu_decode_bench.py)
         **({"spec_decode": spec} if spec else {}),
+        # low-precision serving tiers vs the f32 engine twin at equal
+        # geometry (FIRA_BENCH_QUANT=1; decode/quant.py — the CPU
+        # artifact is docs/QUANT_BENCH_r01.jsonl via
+        # scripts/serve_bench.py --quant)
+        **({"quant_tiers": quant_leg} if quant_leg else {}),
         # multi-chip scaling rows (FIRA_BENCH_MULTICHIP=1; the full
         # artifact is MULTICHIP_r06.json — scripts/multichip_bench.py)
         **({"multichip": multichip} if multichip else {}),

@@ -50,6 +50,7 @@ _DRIVER_FILES = (
     "fira_tpu/decode/runner.py", "fira_tpu/decode/beam.py",
     "fira_tpu/decode/engine.py", "fira_tpu/decode/paging.py",
     "fira_tpu/decode/prefix_cache.py", "fira_tpu/decode/spec.py",
+    "fira_tpu/decode/quant.py",
     "fira_tpu/data/feeder.py", "fira_tpu/data/buckets.py",
     "fira_tpu/data/grouping.py",
     "fira_tpu/parallel/fleet.py",

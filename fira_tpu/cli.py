@@ -164,6 +164,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "sample (validated at parse time, exit 2). "
                         "0/unset = auto: full residency, scheduling "
                         "identical to the unpaged arena")
+    p.add_argument("--kv-dtype", default=None, choices=["f32", "bf16"],
+                   help="test/serve: engine KV arena storage dtype (docs/"
+                        "DECODE_ENGINE.md 'Low-precision tiers'): 'bf16' "
+                        "stores the slot arena (paged pool blocks and the "
+                        "unpaged comparator alike) in bfloat16 — half the "
+                        "kv_bytes_per_slot, machine-recorded in stats — "
+                        "while every read upcasts so attention math stays "
+                        "f32. Output bytes within a tier stay a pure "
+                        "function of the stream (pinned by tests); quality "
+                        "vs f32 is measured, never assumed (bench records "
+                        "bleu_delta_vs_f32). Default 'f32' is byte-"
+                        "identical to the pre-tier engine. Requires "
+                        "--engine")
+    p.add_argument("--serve-precision", default=None,
+                   choices=["f32", "bf16", "int8w"],
+                   help="test/serve: decode weight tier (docs/DECODE_"
+                        "ENGINE.md 'Low-precision tiers'): the decode-only "
+                        "program family (step/draft/verify) runs on a "
+                        "quantized copy of the dominant matmul weights — "
+                        "'int8w' per-channel symmetric int8 with f32 "
+                        "accumulate and on-the-fly dequant, 'bf16' a "
+                        "bfloat16 cast — quantized once at engine build "
+                        "(and per respawn/spare prewarm). Prefill and the "
+                        "f32 default stay full precision; static shapes "
+                        "and the zero-post-warmup-retrace contract are "
+                        "unchanged (labels carry the tier suffix). "
+                        "Requires --engine")
     p.add_argument("--decode-tar-buckets", action="store_true",
                    help="test: let decode buckets keep their OWN tar "
                         "lengths instead of pinning tar full — each "
@@ -500,6 +527,10 @@ def _resolve_cfg(args):
         overrides["kv_pool_blocks"] = args.kv_pool_blocks
     if args.decode_tar_buckets:
         overrides["decode_tar_buckets"] = True
+    if args.kv_dtype is not None:
+        overrides["kv_dtype"] = args.kv_dtype
+    if args.serve_precision is not None:
+        overrides["serve_precision"] = args.serve_precision
     # serve runs ON the slot engine: the serving loop drives the engine's
     # steppable scheduler pieces, so the engine path (and its parse-time
     # fleet/paging validation) is implied by the command itself. The
@@ -740,6 +771,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     from fira_tpu.decode.spec import spec_errors
 
     errs += spec_errors(cfg)
+    # low-precision serving-tier admission (kv_dtype / serve_precision
+    # names, engine path required, training-path rejection) — same exit-2
+    # contract, decode/quant.quant_errors; UNGATED so `--kv-dtype bf16`
+    # without --engine (or on train) names the conflict instead of
+    # silently serving full precision
+    from fira_tpu.decode.quant import quant_errors
+
+    errs += quant_errors(cfg, train=args.command == "train")
     if args.command == "serve":
         # serving knob admission (offered rate, prefill budget vs slots,
         # deadline floor, queue bound) — same exit-2 contract,

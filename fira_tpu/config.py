@@ -278,6 +278,35 @@ class FiraConfig:
     # decode_engine (validated at parse time, exit 2 —
     # decode/spec.spec_errors).
     engine_spec_k: int = 4
+    # --- low-precision serving tiers (decode/quant.py;
+    # docs/DECODE_ENGINE.md "Low-precision tiers") ---
+    # Storage dtype of the decode self-attention K/V arena — the paged
+    # pool's blocks AND the unpaged comparator stripes. "f32" (default)
+    # is the byte-identical contract path; "bf16" stores the arena at
+    # half the bytes (append casts on write, gathers upcast on read, so
+    # attention math stays in the compute dtype) — kv_bytes_per_slot
+    # halves and the equal-HBM slot count doubles again on top of the
+    # paged pool's gain (docs/QUANT_BENCH_r01.jsonl). Engine/fleet
+    # program labels carry the tier (…|bf16kv) and prefix-cache digests
+    # are tier-namespaced, so a cached f32 artifact can never seat a
+    # bf16 slot. Must be f32|bf16; a serving-tier knob, rejected on the
+    # training path (validated at parse time, exit 2 —
+    # decode/quant.quant_errors).
+    kv_dtype: str = "f32"
+    # Weight tier of the DECODE-ONLY program family (step / spec draft /
+    # verify — prefill and the encoder stay f32): "f32" (default) is the
+    # contract path; "bf16" stores the dominant decode matmul weights
+    # (decoder stack, copy-head/vocab projections) in bf16 with the
+    # matmuls accumulating in the compute dtype; "int8w" stores them as
+    # per-channel symmetric int8 with on-the-fly dequant and f32
+    # accumulate — quantized ONCE at engine build (and once per
+    # respawn/spare prewarm), static shapes unchanged, labels suffixed
+    # (…|int8w). Quality is measured, never assumed: BLEU delta +
+    # per-request logprob divergence vs the f32 reference land in the
+    # bench records (docs/QUANT_BENCH_r01.jsonl). Must be f32|bf16|int8w;
+    # int8w/bf16 require decode_engine and are rejected on the training
+    # path (validated at parse time, exit 2 — decode/quant.quant_errors).
+    serve_precision: str = "f32"
 
     # --- online serving (serve/; docs/SERVING.md) ---
     # Offered load in requests/second for the open-loop Poisson arrival

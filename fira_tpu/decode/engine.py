@@ -140,6 +140,7 @@ from fira_tpu.analysis.sanitizer import leak_guard, program_label
 from fira_tpu.config import FiraConfig
 from fira_tpu.decode import paging
 from fira_tpu.decode import prefix_cache as prefix_cache_lib
+from fira_tpu.decode import quant
 from fira_tpu.decode import spec as spec_lib
 from fira_tpu.decode.beam import (_init_beam, _select, _select_factored,
                                   step_valid_mask)
@@ -210,6 +211,11 @@ class EngineStats:
     #                              frame-0 obligation — plain step
     #                              dispatches' worth of work avoided
     spec_frames: int = 0         # verify while_loop frames actually run
+    # low-precision serving tiers (decode/quant.py; both "f32" on the
+    # byte-identical contract path) — stamped by every step dispatch like
+    # the pool fields, so stats resets between timed windows re-learn them
+    kv_dtype: str = "f32"        # K/V arena storage dtype (f32|bf16)
+    serve_precision: str = "f32"  # decode weight tier (f32|bf16|int8w)
 
     @property
     def cache_hit_rate(self) -> float:
@@ -282,6 +288,8 @@ class EngineStats:
             "verify_dispatches": self.verify_dispatches,
             "steps_saved": self.steps_saved,
             "spec_frames": self.spec_frames,
+            "kv_dtype": self.kv_dtype,
+            "serve_precision": self.serve_precision,
         }
 
 
@@ -352,6 +360,22 @@ class SlotEngine:
         self.guard = guard
         self.device = device
         self.tag = tag
+        # low-precision serving tiers (decode/quant.py). The tier tag
+        # suffixes EVERY program label of this engine ("" on the f32/f32
+        # contract path — the default label set is unchanged), and the
+        # weight tier builds a quantized copy of the decode-side params
+        # ONCE, here: a fleet respawn or spare prewarm constructs a fresh
+        # SlotEngine from the original f32 params, so re-quantization is
+        # automatic by construction.
+        qerrs = quant.quant_errors(cfg)
+        if qerrs:
+            raise ValueError("; ".join(qerrs))
+        self._tier_tag = quant.tier_tag(cfg)
+        self._tier_ns = quant.tier_namespace(cfg)
+        self._decode_params, self._wq_scales = quant.quantize_decode_params(
+            params, cfg)
+        if self._decode_params is not params:
+            self._decode_params = jax.device_put(self._decode_params, device)
         # paged KV arena geometry (decode/paging.py). ``pool_blocks`` is
         # THIS engine's pool (a fleet replica's per-chip share); None
         # falls back to cfg.kv_pool_blocks, 0 to the full-residency auto
@@ -418,18 +442,24 @@ class SlotEngine:
             errs = spec_lib.spec_errors(cfg)
             if errs:
                 raise ValueError("; ".join(errs))
-            self._draft = jax.jit(
-                spec_lib.make_drafter(model, cfg, self.slots, self._paged))
+            # the drafter runs on the same decode-side weight tier as the
+            # step it feeds: int8w leaves dequant at the trace top (a
+            # no-op identity for f32/bf16 — scales is None)
+            base_draft = spec_lib.make_drafter(model, cfg, self.slots,
+                                               self._paged)
+            self._draft = jax.jit(lambda p, st: base_draft(
+                quant.dequant_tree(p, self._wq_scales), st))
             self._verify = jax.jit(self._verify_fn, donate_argnums=(1,))
         self.begin_stream()
 
     def label(self, kind: str, geom_tag: Optional[str] = None) -> str:
         """Guard label for one of THIS engine's programs: the geometry tag
-        (prefill family) and the replica tag compose into the standard
-        ``program_label`` format — ``engine_prefill[a16.e256.t12.r1]``,
-        ``engine_step[r1]``; with no tag the single-engine labels are
-        unchanged."""
-        mods = ".".join(t for t in (geom_tag, self.tag) if t)
+        (prefill family), the low-precision tier tag (decode/quant.py —
+        empty on the f32/f32 contract path) and the replica tag compose
+        into the standard ``program_label`` format —
+        ``engine_prefill[a16.e256.t12.r1]``, ``engine_step[bf16kv.int8w.r1]``;
+        with no tags the single-engine labels are unchanged."""
+        mods = ".".join(t for t in (geom_tag, self._tier_tag, self.tag) if t)
         return program_label(kind, mods or None)
 
     def labels(self, table=None) -> List[str]:
@@ -487,8 +517,12 @@ class SlotEngine:
             # dtype marker only: fresh slots seed their self-attention
             # cache at zeros of the ENCODER STATE dtype, exactly like the
             # batched beam's cache0 (which may be wider than the compute
-            # dtype under stable_residual)
-            out["cache_seed"] = jnp.zeros((), states.dtype)
+            # dtype under stable_residual) — unless the low-precision KV
+            # tier pins the arena narrower (cfg.kv_dtype="bf16",
+            # decode/quant.py): _ensure_state allocates the pools/stripes
+            # at this dtype and the HBM accounting follows it
+            out["cache_seed"] = jnp.zeros(
+                (), quant.kv_seed_dtype(cfg, states.dtype))
         else:
             out["states"] = jnp.repeat(states, K, axis=0)
         return out
@@ -500,7 +534,15 @@ class SlotEngine:
         changes WHICH dispatch a harvest lands in, never the math);
         everything else passes through unchanged. Returns (state,
         occupied-slot-step count) — the occupancy numerator, counted
-        exactly, micro-step by micro-step."""
+        exactly, micro-step by micro-step.
+
+        ``params`` is the engine's DECODE-SIDE tree (self._decode_params):
+        under serve_precision="int8w" the quantized leaves dequant ONCE
+        here, at the trace top (per-channel scales embed as trace-time
+        constants), so the scan body below reuses one reconstructed tree
+        instead of dequantizing per micro-step; f32/bf16 pass through
+        untouched (scales is None)."""
+        params = quant.dequant_tree(params, self._wq_scales)
         R = max(1, int(self.cfg.engine_harvest_every))
         if R == 1:
             return self._one_step(params, state)
@@ -521,6 +563,9 @@ class SlotEngine:
         HLO the plain step runs, which is the whole exactness argument).
         Returns (state', occ_entry, [tested, matched, iters]); occ_entry
         rides the _pending_occ slot, the counter vector _pending_spec."""
+        # same trace-top dequant as _step_fn: the while_loop frames reuse
+        # one reconstructed tree (identity for f32/bf16 weight tiers)
+        params = quant.dequant_tree(params, self._wq_scales)
         step = functools.partial(self._one_step, params)
         return spec_lib.run_verify(step, state, drafts, self._spec_k,
                                    self.cfg.tar_len)
@@ -856,7 +901,7 @@ class SlotEngine:
         self._state = self._insert(self._state, chunk, sentinel_ids,
                                    limits, block_rows)
         self._guard_step(self.label(INSERT_LABEL))
-        self._state, occ = self._step(self.params, self._state)
+        self._state, occ = self._step(self._decode_params, self._state)
         self._guard_step(self.label(STEP_LABEL))
         if self._pending_occ is None:
             self._pending_occ = occ  # zero: no slot was active
@@ -869,10 +914,10 @@ class SlotEngine:
             # live row), so the state passes through unchanged — but both
             # programs compile here, not inside a watchdogged dispatch
             km = f"k{self._spec_k}"
-            drafts = self._draft(self.params, self._state)
+            drafts = self._draft(self._decode_params, self._state)
             self._guard_step(self.label(spec_lib.DRAFT_LABEL, km))
-            self._state, occ, pend = self._verify(self.params, self._state,
-                                                  drafts)
+            self._state, occ, pend = self._verify(self._decode_params,
+                                                  self._state, drafts)
             self._guard_step(self.label(spec_lib.VERIFY_LABEL, km))
             self._pending_occ = occ      # zeros: no slot was active
             self._pending_spec = pend
@@ -1146,7 +1191,11 @@ class SlotEngine:
         if self._cache is not None and row_ids:
             digests = host.get("_digests")  # worker-side stamp when present
             if digests is None:
-                digests = prefix_cache_lib.payload_digests(host)
+                # digests are TIER-NAMESPACED (decode/quant.py): a cached
+                # f32 artifact can never seat a bf16 slot — a tier change
+                # is a cache miss, never a wrong answer
+                digests = prefix_cache_lib.payload_digests(
+                    host, namespace=self._tier_ns)
         # PASS 1 — in-flight dedup (pure reads; maps commit below): rows
         # whose digest matches an admitted-but-unharvested row become
         # followers of that seat instead of taking one of their own
@@ -1340,11 +1389,11 @@ class SlotEngine:
         # step->harvest cadence contract is unchanged.
         spec_now = self._spec_tier is not None and self._spec_cd == 0
         if spec_now:
-            drafts = self._draft(self.params, self._state)
+            drafts = self._draft(self._decode_params, self._state)
             new_state, new_occ, new_spec = self._verify(
-                self.params, self._state, drafts)
+                self._decode_params, self._state, drafts)
         else:
-            new_state, new_occ = self._step(self.params, self._state)
+            new_state, new_occ = self._step(self._decode_params, self._state)
             new_spec = None
         if self.retired:
             # the watchdog expired while the dispatch call was in flight:
@@ -1374,6 +1423,8 @@ class SlotEngine:
         st.pool_blocks = self._pool_blocks
         st.kv_block_size = self._block_size
         st.kv_bytes_per_slot = self._kv_bytes_per_slot
+        st.kv_dtype = self.cfg.kv_dtype
+        st.serve_precision = self.cfg.serve_precision
         if self._paged:
             used = self._pool_blocks - len(self._free_blocks)
             st.block_steps += used

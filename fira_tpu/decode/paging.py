@@ -176,6 +176,16 @@ def prefix_cache_errors(cfg: FiraConfig) -> List[str]:
     return errs
 
 
+def kv_itemsize(cfg: FiraConfig) -> int:
+    """Bytes per K/V arena element under the serving tier (docs/
+    DECODE_ENGINE.md "Low-precision tiers"): 2 when ``cfg.kv_dtype`` is
+    ``bf16``, else the f32 default's 4. Host-side mirror of the engine's
+    own accounting — the engine derives the itemsize from the prefill
+    chunk's ``cache_seed`` dtype at allocation time; bench/test callers
+    use this helper so their expected-bytes math names the same knob."""
+    return 2 if cfg.kv_dtype == "bf16" else 4
+
+
 def block_bytes(cfg: FiraConfig, block_size: int, itemsize: int) -> int:
     """HBM bytes of ONE pool block pair (K and V): all layers x all beam
     lanes x heads x block positions x head dim."""

@@ -64,11 +64,18 @@ ARTIFACT_FIELDS_KV = ("src_mask", "diff", "sub_token",
 ARTIFACT_FIELDS_NOKV = ("src_mask", "diff", "sub_token", "states")
 
 
-def _digest_arrays(items: Iterable[Tuple[str, np.ndarray]]) -> str:
+def _digest_arrays(items: Iterable[Tuple[str, np.ndarray]],
+                   namespace: bytes = b"") -> str:
     """Keyed blake2b over (name, dtype, shape, bytes) of each array —
     shape/dtype are hashed so a bucket geometry change can never alias a
-    content match across geometries."""
+    content match across geometries. ``namespace`` (the serving tier's
+    digest namespace, decode/quant.tier_namespace) prefixes the hash so
+    artifacts produced under different low-precision tiers can never
+    alias: a tier change is a cache MISS, never a wrong answer. Empty —
+    digests byte-identical to before — on the f32/f32 contract path."""
     h = hashlib.blake2b(key=_DIGEST_KEY, digest_size=16)
+    if namespace:
+        h.update(namespace)
     for name, arr in items:
         a = np.ascontiguousarray(arr)
         h.update(name.encode())
@@ -78,28 +85,34 @@ def _digest_arrays(items: Iterable[Tuple[str, np.ndarray]]) -> str:
     return h.hexdigest()
 
 
-def payload_digests(host: Dict) -> List[Optional[str]]:
+def payload_digests(host: Dict, namespace: bytes = b""
+                    ) -> List[Optional[str]]:
     """One content digest per VALID row of a packed host batch (None for
     pad rows): every wire field (host-only "_" keys and the positional
     ``valid`` mask excluded) contributes its row's bytes. Two rows digest
     equal iff their packed payloads are byte-identical at the same
-    geometry — the dedup/cache identity."""
+    geometry AND the same ``namespace`` (the serving tier's —
+    decode/quant.tier_namespace; empty on the f32/f32 contract path) —
+    the dedup/cache identity."""
     valid = np.asarray(host["valid"], dtype=bool)
     fields = sorted(k for k in host if not k.startswith("_") and k != "valid")
     out: List[Optional[str]] = []
     for r in range(valid.shape[0]):
-        out.append(_digest_arrays((f, np.asarray(host[f])[r])  # firacheck: allow[HOST-SYNC] packed host batches are numpy already (the feeder assembles on host); digesting their bytes is pure host work, no device value exists here
-                                  for f in fields) if valid[r] else None)
+        out.append(_digest_arrays(((f, np.asarray(host[f])[r])  # firacheck: allow[HOST-SYNC] packed host batches are numpy already (the feeder assembles on host); digesting their bytes is pure host work, no device value exists here
+                                   for f in fields), namespace)
+                   if valid[r] else None)
     return out
 
 
-def stamp_digests(host: Dict) -> Dict:
+def stamp_digests(host: Dict, namespace: bytes = b"") -> Dict:
     """Attach ``_digests`` (host-only metadata, stripped from the wire by
     the feeder like every "_" key) to a packed batch — the worker-side
     stamping hook (data/feeder.assembly_tasks ``stamp=``,
     serve/server._request_tasks), so the scheduler thread never pays the
-    hashing."""
-    host["_digests"] = payload_digests(host)
+    hashing. ``namespace``: same tier namespacing as
+    :func:`payload_digests` — the stamping side and the engine's on-demand
+    side both derive it from the SAME cfg, so they always agree."""
+    host["_digests"] = payload_digests(host, namespace)
     return host
 
 

@@ -92,9 +92,14 @@ def _decode_tasks(data, cfg: FiraConfig):
         # content digests computed worker-side with the rest of assembly
         # (bucketed and unbucketed streams alike — the engine's on-demand
         # fallback exists only for streams that bypass these task
-        # builders)
+        # builders); the digest carries the serving tier's namespace so a
+        # cached f32 artifact never seats a bf16 slot (decode/quant.py)
+        import functools
+
+        from fira_tpu.decode import quant
         from fira_tpu.decode.prefix_cache import stamp_digests
-        stamp = stamp_digests
+        stamp = functools.partial(stamp_digests,
+                                  namespace=quant.tier_namespace(cfg))
     if cfg.buckets:
         table = buckets_lib.decode_table(cfg)
         # tar-bucketed decode assigns by reference-message extent (the

@@ -53,6 +53,17 @@ Program family: ``engine_draft[k<k>...]`` + ``engine_verify[k<k>...]``, one
 fixed-(S, k) member each, declared in the compile-guard family next to the
 step/insert/harvest programs (replica tags compose: ``engine_verify[k4.r1]``)
 — zero post-warmup retraces with spec armed.
+
+Low-precision serving tiers (decode/quant.py) compose with NO code here:
+the drafter's scratch caches inherit the arena's storage dtype (the unpaged
+beam-0 slice stays bf16 and decode_step_multi's read-upcast rule handles
+it; the paged gather_block_kv_beam upcasts at the gather), and the engine
+wraps the drafter so the int8w weight tier dequantizes at the draft trace
+top exactly like the step/verify programs. Draft math under a tier is
+acceptance-only — the verify body is still the engine's own step program on
+the engine's own params, so the within-tier exactness argument above is
+unchanged: accepted prefixes are bit-identical to that tier's plain decode
+(labels carry the tier suffix, e.g. ``engine_verify[k4.bf16kv.int8w.r1]``).
 """
 
 from __future__ import annotations

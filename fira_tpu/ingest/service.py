@@ -487,8 +487,15 @@ def ingest_request_tasks(requests: Sequence[str], cfg: FiraConfig,
 
     stamp = None
     if cfg.prefix_cache:
+        # tier-namespaced like every other stamping site: the digest
+        # commits to the serving precision so artifacts cached under one
+        # tier can never seat a slot under another (decode/quant.py)
+        import functools
+
+        from fira_tpu.decode import quant
         from fira_tpu.decode.prefix_cache import stamp_digests
-        stamp = stamp_digests
+        stamp = functools.partial(stamp_digests,
+                                  namespace=quant.tier_namespace(cfg))
 
     for i, text in enumerate(requests):
         def task(text=text, i=i, attempts={"n": 0}):

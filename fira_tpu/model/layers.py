@@ -331,12 +331,17 @@ def gather_block_kv(pool_l: jnp.ndarray, block_tab: jnp.ndarray
 
     Returns (S*K, H, W*BS, d_head): row-major (slot, beam) rows in the
     exact layout ``Attention.attend`` consumes, bit-identical for every
-    written position to the whole-sequence cache it replaces."""
+    written position to the whole-sequence cache it replaces. A
+    low-precision pool (cfg.kv_dtype="bf16" — decode/quant.py) UPCASTS on
+    read to the stable dtype, so the attention math downstream runs full
+    precision whatever the arena stores; for an f32 pool the cast is a
+    no-op (the byte-identity contract path)."""
     P, K, H, BS, d_head = pool_l.shape
     S, W = block_tab.shape
     blocks = pool_l[block_tab]                      # (S, W, K, H, BS, dh)
     blocks = blocks.transpose(0, 2, 3, 1, 4, 5)     # (S, K, H, W, BS, dh)
-    return blocks.reshape(S * K, H, W * BS, d_head)
+    return blocks.reshape(S * K, H, W * BS, d_head).astype(
+        stable_dtype(pool_l.dtype))
 
 
 def gather_block_kv_beam(pool_l: jnp.ndarray, block_tab: jnp.ndarray,
@@ -346,12 +351,14 @@ def gather_block_kv_beam(pool_l: jnp.ndarray, block_tab: jnp.ndarray,
     ``beam``, gathered without materializing the other K-1 lanes. The
     speculative draft-tier roll (decode/spec.py) copies the top-beam lane
     into a dense scratch cache once per draft and rolls on that — the
-    pool itself is never written by a drafter."""
+    pool itself is never written by a drafter. Same read-upcast rule as
+    :func:`gather_block_kv` (no-op for an f32 pool)."""
     P, K, H, BS, d_head = pool_l.shape
     S, W = block_tab.shape
     blocks = pool_l[:, beam][block_tab]             # (S, W, H, BS, dh)
     blocks = blocks.transpose(0, 2, 1, 3, 4)        # (S, H, W, BS, dh)
-    return blocks.reshape(S, H, W * BS, d_head)
+    return blocks.reshape(S, H, W * BS, d_head).astype(
+        stable_dtype(pool_l.dtype))
 
 
 def append_block_kv(pool: jnp.ndarray, layer: int, blk: jnp.ndarray,
@@ -363,8 +370,11 @@ def append_block_kv(pool: jnp.ndarray, layer: int, blk: jnp.ndarray,
     per-row block id / beam lane / in-block offset; new: (B, H, d_head).
     ``mode="drop"`` makes sentinel block ids (idle/done rows the engine
     masked out) write NOWHERE — a freed block can never be scribbled on by
-    the slot that used to own it."""
-    return pool.at[layer, blk, krow, :, off, :].set(new, mode="drop")
+    the slot that used to own it. The write CASTS to the pool's storage
+    dtype (cfg.kv_dtype="bf16" stores the arena half-width —
+    decode/quant.py; a no-op for the f32 pool)."""
+    return pool.at[layer, blk, krow, :, off, :].set(
+        new.astype(pool.dtype), mode="drop")
 
 
 class FeedForward(nn.Module):

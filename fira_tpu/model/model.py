@@ -302,13 +302,20 @@ class Decoder(nn.Module):
         """
         x = self.embed(tok) + jax.lax.dynamic_slice_in_dim(
             self._pos_table(), pos_idx, 1, axis=0)[None, :, :]
+        # cache writes CAST to the arena's storage dtype and reads UPCAST
+        # to the stable dtype (both no-ops for the default f32 arena):
+        # cfg.kv_dtype="bf16" stores the K/V stripes half-width while the
+        # attention math stays full precision (decode/quant.py)
+        cd = stable_dtype(k_cache.dtype)
         for i in range(self.cfg.num_layers):
             sa = getattr(self, f"self_attn_{i}")
             k_new, v_new = sa.project_kv(x, x)       # (B, H, 1, d_head)
-            k_cache = k_cache.at[i, :, :, pos_idx, :].set(k_new[:, :, 0, :])
-            v_cache = v_cache.at[i, :, :, pos_idx, :].set(v_new[:, :, 0, :])
-            x = sa.attend(x, k_cache[i], v_cache[i], self_mask,
-                          deterministic=True)
+            k_cache = k_cache.at[i, :, :, pos_idx, :].set(
+                k_new[:, :, 0, :].astype(k_cache.dtype))
+            v_cache = v_cache.at[i, :, :, pos_idx, :].set(
+                v_new[:, :, 0, :].astype(v_cache.dtype))
+            x = sa.attend(x, k_cache[i].astype(cd), v_cache[i].astype(cd),
+                          self_mask, deterministic=True)
             x = getattr(self, f"cross_attn_{i}").attend(
                 x, cross_k[i], cross_v[i], sou_mask, deterministic=True)
             x = getattr(self, f"ffn_{i}")(x, deterministic=True)
@@ -342,13 +349,17 @@ class Decoder(nn.Module):
         pos = pos_idx.astype(jnp.int32)
         b_idx = jnp.arange(B)
         x = self.embed_at(tok, pos)
+        # same storage-cast / read-upcast rule as decode_step (no-op f32)
+        cd = stable_dtype(k_cache.dtype)
         for i in range(self.cfg.num_layers):
             sa = getattr(self, f"self_attn_{i}")
             k_new, v_new = sa.project_kv(x, x)       # (B, H, 1, d_head)
-            k_cache = k_cache.at[i, b_idx, :, pos, :].set(k_new[:, :, 0, :])
-            v_cache = v_cache.at[i, b_idx, :, pos, :].set(v_new[:, :, 0, :])
-            x = sa.attend(x, k_cache[i], v_cache[i], self_mask,
-                          deterministic=True)
+            k_cache = k_cache.at[i, b_idx, :, pos, :].set(
+                k_new[:, :, 0, :].astype(k_cache.dtype))
+            v_cache = v_cache.at[i, b_idx, :, pos, :].set(
+                v_new[:, :, 0, :].astype(v_cache.dtype))
+            x = sa.attend(x, k_cache[i].astype(cd), v_cache[i].astype(cd),
+                          self_mask, deterministic=True)
             x = getattr(self, f"cross_attn_{i}").attend(
                 x, cross_k[i], cross_v[i], sou_mask, deterministic=True)
             x = getattr(self, f"ffn_{i}")(x, deterministic=True)

@@ -133,6 +133,14 @@ class FleetStats:
                                  default=0),
             "kv_bytes_per_slot": max((r.kv_bytes_per_slot
                                       for r in self.replicas), default=0),
+            # low-precision serving tiers (decode/quant.py): cfg-uniform
+            # across the fleet, so any replica's stamp is THE answer —
+            # "f32" when no replica has dispatched yet
+            "kv_dtype": next((r.kv_dtype for r in self.replicas
+                              if r.step_dispatches), "f32"),
+            "serve_precision": next((r.serve_precision
+                                     for r in self.replicas
+                                     if r.step_dispatches), "f32"),
             "peak_blocks": tot("peak_blocks"),
             "pool_utilization": pool_util,
             "replicas": len(self.replicas),

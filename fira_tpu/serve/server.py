@@ -1403,16 +1403,22 @@ def _request_tasks(data, cfg: FiraConfig, n: int, table, assignment,
     thread never pays the hashing."""
     from fira_tpu.data.batching import make_batch
     from fira_tpu.data.feeder import task_note
+    from fira_tpu.decode import quant
     from fira_tpu.decode.prefix_cache import stamp_digests
 
     stamp = cfg.prefix_cache
+    # digests carry the low-precision tier's namespace (decode/quant.py):
+    # worker-side stamping and the engine's on-demand hashing both derive
+    # it from the same cfg, so a cached f32 artifact never seats a bf16
+    # slot and a tier change is a miss, never a wrong answer
+    tier_ns = quant.tier_namespace(cfg)
     for i in range(n):
         j = int(mix[i]) if mix is not None else i  # firacheck: allow[HOST-SYNC] mix is a host request->sample index map; task generation is pure host-side planning
         geom = table[int(assignment[i])] if table is not None else None  # firacheck: allow[HOST-SYNC] host numpy bucket-assignment array — task generation is pure host-side planning
         def task(j=j, geom=geom):
             b = make_batch(data, np.asarray([j]), cfg, batch_size=1,  # firacheck: allow[HOST-SYNC] np.asarray of a host int list builds the make_batch index chunk; no device value exists here
                            geom=geom)
-            return stamp_digests(b) if stamp else b
+            return stamp_digests(b, tier_ns) if stamp else b
         task.note = task_note(
             [j], geom_tag=buckets_lib.geom_tag(geom) if geom else None,
             site="serve request")

@@ -15,7 +15,8 @@ echo "== firacheck: static JAX-hazard scan =="
 # fira_tpu/data/feeder.py, fira_tpu/data/buckets.py,
 # fira_tpu/data/grouping.py, fira_tpu/decode/engine.py,
 # fira_tpu/decode/paging.py, fira_tpu/decode/prefix_cache.py,
-# fira_tpu/decode/spec.py, fira_tpu/parallel/fleet.py,
+# fira_tpu/decode/spec.py, fira_tpu/decode/quant.py,
+# fira_tpu/parallel/fleet.py,
 # fira_tpu/serve/server.py, fira_tpu/ingest/difftext.py,
 # fira_tpu/ingest/service.py, fira_tpu/ingest/cache.py,
 # fira_tpu/robust/faults.py,
@@ -24,7 +25,8 @@ echo "== firacheck: static JAX-hazard scan =="
 # input pipeline, the bucket packer, the grouped dispatch scheduler,
 # the slot-refill decode engine, the paged-KV arena
 # geometry/validation, the cross-request prefix cache, the speculative
-# draft-and-verify decode programs, the replicated
+# draft-and-verify decode programs, the low-precision serving tiers
+# (KV-arena dtype + decode weight quantization), the replicated
 # decode fleet, the arrival-timed serving loop, the raw-diff ingest
 # pipeline (+ its whole-diff result cache / hunk memo / process
 # executor) and the fault-injection/watchdog/recovery machinery. Their
@@ -38,7 +40,8 @@ JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu/data/feeder.py fira_tpu/data/buckets.py \
     fira_tpu/data/grouping.py fira_tpu/decode/engine.py \
     fira_tpu/decode/paging.py fira_tpu/decode/prefix_cache.py \
-    fira_tpu/decode/spec.py fira_tpu/parallel/fleet.py \
+    fira_tpu/decode/spec.py fira_tpu/decode/quant.py \
+    fira_tpu/parallel/fleet.py \
     fira_tpu/serve/server.py fira_tpu/ingest/difftext.py \
     fira_tpu/ingest/service.py fira_tpu/ingest/cache.py \
     fira_tpu/robust/faults.py \
@@ -134,6 +137,17 @@ echo "== spec smoke: spec-on serve == plain drain bytes (docs/DECODE_ENGINE.md '
 # retire the replica, requeue onto the survivor, and serve the same
 # bytes — speculation must not widen the fault blast radius.
 JAX_PLATFORMS=cpu python scripts/serve_bench.py --spec-smoke || exit $?
+
+echo "== quant smoke: low-precision tiers serve a tiny stream (docs/DECODE_ENGINE.md 'Low-precision tiers') =="
+# The bf16 KV arena and int8 weight tier stay machine-enforced in
+# tier-1: the same tiny stream served f32, bf16-KV, and int8w under the
+# armed compile guard — each tier's output bytes must be stable across
+# repeat runs (within-tier determinism), the f32 tier must match the
+# plain drain byte-for-byte, each tier's BLEU delta vs f32 must stay
+# inside the measured bound (quality measured, never assumed), stats
+# must stamp the tier, and zero post-warmup compiles must hold from the
+# tier-suffixed program family.
+JAX_PLATFORMS=cpu python scripts/serve_bench.py --quant-smoke || exit $?
 
 echo "== chaos smoke: seeded fault at each site (docs/FAULTS.md) =="
 # The graceful-degradation contracts stay machine-enforced in tier-1:

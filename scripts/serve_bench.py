@@ -90,6 +90,24 @@ Modes:
               must still retire/requeue byte-identically (the check.sh
               leg of the speculative-decode equivalence contract). Exit
               nonzero on any violation.
+  --quant     the LOW-PRECISION-TIER leg (docs/QUANT_BENCH_r01.jsonl;
+              docs/DECODE_ENGINE.md "Low-precision tiers"): the equal-
+              HBM slot sweep (unpaged f32 vs the paged bf16 KV arena at
+              4x the slots against the same pool bytes — the
+              paged_equal_hbm_slot_gain row records the machine-
+              measured >= 4.0), per-tier serve rows (rps + p50/p99 e2e
+              at the knee rate, stats-stamped kv_dtype /
+              serve_precision), and the measured-quality rows on the
+              frozen split (bleu_delta_vs_f32 +
+              logprob_divergence_{mean,p99} per tier, |BLEU delta| <=
+              0.5 asserted in-bench). Exit nonzero on any violation.
+  --quant-smoke
+              the same tiny stream served f32 / bf16-KV / int8w under
+              the armed compile guard: per-tier byte-stability across
+              repeat runs, f32 == plain drain bytes, stats tier stamps,
+              measured BLEU-delta bound, bf16 halves kv_bytes_per_slot,
+              zero post-warmup retraces (the check.sh leg). Exit
+              nonzero on any violation.
 
 Env knobs: FIRA_SERVE_COMMITS (synthetic corpus size, default 600),
 FIRA_SERVE_RATE_FRACS (default "0.25,0.5,0.8,1.2,1.6" x drain capacity),
@@ -125,6 +143,7 @@ DEFAULT_OUT = os.path.join(REPO_ROOT, "docs", "SERVE_BENCH_r01.jsonl")
 DEFAULT_CACHE_OUT = os.path.join(REPO_ROOT, "docs", "CACHE_BENCH_r01.jsonl")
 DEFAULT_INGEST_OUT = os.path.join(REPO_ROOT, "docs",
                                   "INGEST_BENCH_r02.jsonl")
+DEFAULT_QUANT_OUT = os.path.join(REPO_ROOT, "docs", "QUANT_BENCH_r01.jsonl")
 
 # the offline preprocessing baseline the online ingest rate is compared
 # against (docs/PERF.md § Preprocessing: host-side shard workers over
@@ -1146,6 +1165,281 @@ def spec_smoke() -> int:
     return 0 if ok else 1
 
 
+def quant_smoke() -> int:
+    """Low-precision serving-tier leg (scripts/check.sh,
+    docs/DECODE_ENGINE.md "Low-precision tiers"): the SAME tiny stream
+    served under the f32 default, the bf16 KV arena, and the int8
+    weight tier, each under the armed compile guard. Per tier: output
+    bytes must be STABLE across repeat runs (within-tier determinism —
+    bytes are a pure function of the stream), the f32 tier must match
+    the plain drain byte-for-byte (the default-path byte-identity
+    contract), stats must stamp the tier, zero post-warmup compiles
+    must hold from the tier-suffixed program family, and the tier's
+    BLEU delta vs f32 must stay inside the measured bound (quality
+    measured, never assumed)."""
+    import dataclasses
+
+    from fira_tpu.analysis import sanitizer
+    from fira_tpu.decode.runner import run_test
+    from fira_tpu.serve import poisson_times, serve_split
+
+    dataset, _corpus, cfg, model, params = _setup(
+        40, batch=6, slots=6, eos_delta=4.0)
+    n = len(dataset.splits["train"])
+    times = poisson_times(n, rate=0.5, seed=3)  # virtual-clock units
+    work = tempfile.mkdtemp(prefix="fira_quant_smoke_")
+
+    drain = run_test(model, params, dataset, cfg,
+                     out_dir=os.path.join(work, "plain"), split="train")
+    ref = open(drain["output_path"], "rb").read()
+    f32_bleu = drain["sentence_bleu"]
+
+    tiers = [("f32", "f32"), ("bf16", "f32"), ("f32", "int8w")]
+    rows, ok = [], True
+    for kv, sp in tiers:
+        tcfg = dataclasses.replace(cfg, kv_dtype=kv, serve_precision=sp)
+        runs = []
+        for rep in range(2):
+            with sanitizer.sanitize(nans=False, infs=False) as guard:
+                m = serve_split(
+                    model, params, dataset, tcfg, arrival_times=times,
+                    out_dir=os.path.join(work, f"{kv}_{sp}_{rep}"),
+                    split="train", clock="virtual", guard=guard)
+                extra = guard.compiles_after_warmup()
+            runs.append((open(m["output_path"], "rb").read(), m, extra))
+        (b0, m0, x0), (b1, _m1, x1) = runs
+        e, sv = m0["engine"], m0["serve"]
+        bleu_delta = m0["sentence_bleu"] - f32_bleu
+        row = {
+            "kv_dtype": kv, "serve_precision": sp,
+            "bytes_stable": b0 == b1,
+            "bytes_equal_plain": b0 == ref,
+            "compiles_after_warmup": x0 + x1,
+            "completed": sv["completed"], "offered": n,
+            "stats_kv_dtype": e["kv_dtype"],
+            "stats_serve_precision": e["serve_precision"],
+            "kv_bytes_per_slot": e["kv_bytes_per_slot"],
+            "bleu_delta_vs_f32": round(bleu_delta, 4),
+        }
+        rows.append(row)
+        ok = ok and (b0 == b1 and x0 + x1 == 0 and sv["completed"] == n
+                     and e["kv_dtype"] == kv and e["serve_precision"] == sp
+                     and abs(bleu_delta) <= 0.5)
+        if (kv, sp) == ("f32", "f32"):
+            ok = ok and b0 == ref
+    # the bf16 arena's honest HBM accounting: half the f32 bytes/slot
+    ok = ok and rows[1]["kv_bytes_per_slot"] * 2 == rows[0][
+        "kv_bytes_per_slot"]
+    print(json.dumps({"smoke": "ok" if ok else "FAIL", "tiers": rows},
+                     sort_keys=True), flush=True)
+    return 0 if ok else 1
+
+
+def quant_measure(out_path: str) -> int:
+    """The LOW-PRECISION-TIER record (docs/QUANT_BENCH_r01.jsonl;
+    docs/DECODE_ENGINE.md "Low-precision tiers"). Three legs:
+
+    - ``equal_hbm_sweep`` — the HBM claim, machine-recorded: an unpaged
+      f32 arena at a long tar budget vs the paged bf16 arena serving 4x
+      the slots against the SAME pool bytes (bf16 halves the per-
+      position bytes, paging's own equal-HBM doubling stacks on top) —
+      ``kv_bytes_per_slot`` quarters, the ``paged_equal_hbm_slot_gain``
+      row records >= 4.0.
+    - ``tier_serve`` — rps + p50/p99 e2e at the knee rate (0.8 x
+      measured drain capacity) per tier, stats-stamped.
+    - ``tier_quality`` — the measured-quality contract on the frozen
+      split: ``bleu_delta_vs_f32`` and ``logprob_divergence_{mean,p99}``
+      per tier, with |BLEU delta| <= 0.5 asserted IN-BENCH (exit
+      nonzero on violation — a committed row is a machine-checked row).
+
+    Env: FIRA_QUANT_COMMITS (default 120), FIRA_QUANT_SWEEP_COMMITS
+    (default 64), FIRA_QUANT_KNEE_FRAC (default 0.8)."""
+    import dataclasses
+
+    import numpy as np
+
+    from fira_tpu.data.feeder import Feeder
+    from fira_tpu.decode import engine as engine_lib
+    from fira_tpu.decode import paging
+    from fira_tpu.decode.runner import _decode_tasks
+    from fira_tpu.serve import poisson_times
+
+    rows = []
+
+    def emit(row):
+        rows.append(row)
+        print(json.dumps(row, sort_keys=True), flush=True)
+
+    # --- leg 1: equal-HBM slot sweep at a long tar budget ------------------
+    from fira_tpu.config import fira_tiny
+    from fira_tpu.data.batching import make_batch
+    from fira_tpu.data.dataset import FiraDataset
+    from fira_tpu.data.synthetic import write_corpus_dir
+    from fira_tpu.decode.beam import eos_biased_params
+    from fira_tpu.model.model import FiraModel
+    from fira_tpu.train.state import init_state
+
+    sweep_n = int(os.environ.get("FIRA_QUANT_SWEEP_COMMITS", "64"))
+    sbatch = 4
+    sweep_dir = tempfile.mkdtemp(prefix="fira_quant_sweep_")
+    write_corpus_dir(sweep_dir, sweep_n, seed=13)
+    cfg_s = fira_tiny(batch_size=8, test_batch_size=sbatch,
+                      decode_engine=True, tar_len=64,
+                      decode_tar_buckets=True)
+    cfg_s = cfg_s.replace(buckets=(
+        (cfg_s.ast_change_len, cfg_s.max_edges, 32),))
+    dataset_s = FiraDataset(sweep_dir, cfg_s)
+    cfg_s = dataset_s.cfg
+    split_s = dataset_s.splits["train"]
+    sample = make_batch(split_s, np.arange(min(sbatch, len(split_s))),
+                        cfg_s, batch_size=sbatch)
+    model_s = FiraModel(cfg_s)
+    params_s = eos_biased_params(init_state(model_s, cfg_s, sample).params,
+                                 delta=4.0)
+    bs_s = paging.resolve_block_size(cfg_s)
+    w_long = paging.blocks_per_seq(cfg_s.tar_len, bs_s)
+
+    def sweep_row(tag, cfg_row, *, slots=None, pool_blocks=None):
+        eng = engine_lib.SlotEngine(model_s, params_s, cfg_row,
+                                    slots=slots, pool_blocks=pool_blocks)
+
+        def drive():
+            tasks, _ = _decode_tasks(split_s, cfg_row)
+            with Feeder(tasks, num_workers=2, depth=2) as feed:
+                for _ in eng.run(feed):
+                    pass
+
+        drive()                          # warm: compiles off the clock
+        eng.stats = engine_lib.EngineStats(slots=eng.slots)
+        t0 = time.perf_counter()
+        drive()
+        dt = time.perf_counter() - t0
+        st = eng.stats.summary()
+        emit({"mode": "equal_hbm_sweep", "tag": tag,
+              "commits_per_sec": round(st["commits"] / dt, 2),
+              "slots": st["slots"], "tar_len": cfg_row.tar_len,
+              "paged": eng._paged, "pool_blocks": st["pool_blocks"],
+              "kv_block_size": st["kv_block_size"],
+              "kv_bytes_per_slot": st["kv_bytes_per_slot"],
+              "kv_dtype": st["kv_dtype"],
+              "serve_precision": st["serve_precision"]})
+        return st
+
+    st_unpaged = sweep_row(
+        "unpaged_f32_tar64", cfg_s.replace(engine_paged_kv=False))
+    st_bf4x = sweep_row(
+        "paged_bf16kv_tar64_4xslots", cfg_s.replace(kv_dtype="bf16"),
+        slots=4 * sbatch, pool_blocks=2 * sbatch * w_long)
+    gain = st_bf4x["slots"] / st_unpaged["slots"]
+    emit({"mode": "equal_hbm_sweep", "tag": "paged_equal_hbm_slot_gain",
+          "kv_dtype": "bf16",
+          "slots": f"{st_unpaged['slots']} -> {st_bf4x['slots']}",
+          "kv_bytes_per_slot": f"{st_unpaged['kv_bytes_per_slot']} -> "
+                               f"{st_bf4x['kv_bytes_per_slot']}",
+          "value": round(gain, 2)})
+    ok = gain >= 4.0 and st_bf4x["kv_bytes_per_slot"] * 4 \
+        == st_unpaged["kv_bytes_per_slot"]
+
+    # --- legs 2+3: per-tier serve at the knee + measured quality -----------
+    n_commits = int(os.environ.get("FIRA_QUANT_COMMITS", "120"))
+    knee_frac = float(os.environ.get("FIRA_QUANT_KNEE_FRAC", "0.8"))
+    dataset, _corpus, cfg, model, params = _setup(
+        n_commits, batch=6, slots=8, eos_delta=4.0)
+    data = dataset.splits["train"]
+    n = len(data)
+    work = tempfile.mkdtemp(prefix="fira_quant_out_")
+
+    def tier_outputs(tcfg):
+        """One drain collecting (tokens, probs) per sample + the warm
+        engine for the serve row (per-instance jit: the serve row must
+        reuse the drained engine's programs)."""
+        eng = engine_lib.SlotEngine(model, params, tcfg)
+        out = {}
+
+        def drive(collect):
+            tasks, _ = _decode_tasks(data, tcfg)
+            with Feeder(tasks, num_workers=2, depth=2) as feed:
+                for it in eng.run(feed):
+                    if collect:
+                        out[it.position] = (np.asarray(it.tokens),
+                                            np.asarray(it.probs))
+            return out
+
+        drive(True)
+        return out, eng
+
+    tiers = [("f32", "f32"), ("bf16", "f32"), ("f32", "int8w"),
+             ("bf16", "int8w")]
+    f32_out = None
+    f32_bleu = None
+    drain_rps = None
+    for kv, sp in tiers:
+        tcfg = dataclasses.replace(cfg, kv_dtype=kv, serve_precision=sp)
+        out, eng = tier_outputs(tcfg)
+        if drain_rps is None:
+            # f32 drain capacity: one timed re-drain on the warm engine
+            eng.stats = engine_lib.EngineStats(slots=eng.slots)
+            t0 = time.perf_counter()
+            tasks, _ = _decode_tasks(data, tcfg)
+            with Feeder(tasks, num_workers=2, depth=2) as feed:
+                for _ in eng.run(feed):
+                    pass
+            drain_rps = eng.stats.commits / (time.perf_counter() - t0)
+            emit({"mode": "drain_capacity", "kv_dtype": kv,
+                  "serve_precision": sp,
+                  "drain_rps": round(drain_rps, 3), "n_requests": n})
+            # one untimed serve warm pass (the measure() discipline):
+            # text-cooking/BLEU first-use costs off the timed rows
+            _serve_row(model, params, dataset, tcfg,
+                       poisson_times(min(n, 24), drain_rps, seed=7),
+                       os.path.join(work, "warm"), engine=eng)
+
+        # quality vs f32 on the frozen split
+        if f32_out is None:
+            f32_out = out
+            div_mean = div_p99 = 0.0
+        else:
+            diffs = np.concatenate([
+                np.abs(out[p][1].ravel() - f32_out[p][1].ravel())
+                for p in sorted(f32_out)])
+            div_mean = float(np.mean(diffs))
+            div_p99 = float(np.percentile(diffs, 99))
+
+        # serve at the knee rate on the warm engine
+        eng.stats = engine_lib.EngineStats(slots=eng.slots)
+        times = poisson_times(n, knee_frac * drain_rps, seed=7)
+        sv, m = _serve_row(model, params, dataset, tcfg, times,
+                           os.path.join(work, f"{kv}_{sp}"), engine=eng)
+        if f32_bleu is None:
+            f32_bleu = m["sentence_bleu"]
+        bleu_delta = m["sentence_bleu"] - f32_bleu
+        e = m["engine"]
+        emit({"mode": "tier_serve", "kv_dtype": kv, "serve_precision": sp,
+              "rate_frac": knee_frac,
+              "offered_rps": round(knee_frac * drain_rps, 3),
+              "completed": sv["completed"],
+              "throughput_rps": sv["throughput_rps"],
+              "p50_e2e_s": sv["p50_e2e_s"], "p99_e2e_s": sv["p99_e2e_s"],
+              "kv_bytes_per_slot": e["kv_bytes_per_slot"],
+              "stats_kv_dtype": e["kv_dtype"],
+              "stats_serve_precision": e["serve_precision"]})
+        emit({"mode": "tier_quality", "kv_dtype": kv,
+              "serve_precision": sp, "n": n,
+              "sentence_bleu": round(m["sentence_bleu"], 4),
+              "bleu_delta_vs_f32": round(bleu_delta, 4),
+              "logprob_divergence_mean": round(div_mean, 6),
+              "logprob_divergence_p99": round(div_p99, 6)})
+        ok = ok and abs(bleu_delta) <= 0.5 and sv["completed"] == n
+
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+    print(json.dumps({"quant_bench": "ok" if ok else "FAIL",
+                      "rows": len(rows), "out": out_path}), flush=True)
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -1169,6 +1463,12 @@ def main() -> int:
                     help="speculative decode: spec-on serve bytes == "
                          "plain drain bytes with real acceptances, plus "
                          "the fault-under-spec fleet leg (scripts/check.sh)")
+    ap.add_argument("--quant", action="store_true",
+                    help="low-precision serving tiers leg "
+                         "(docs/QUANT_BENCH_r01.jsonl)")
+    ap.add_argument("--quant-smoke", action="store_true",
+                    help="tiers: per-tier byte-stability + measured BLEU "
+                         "bound + zero retraces (scripts/check.sh)")
     ap.add_argument("--out", default=None,
                     help=f"JSONL record path (default {DEFAULT_OUT}; "
                          f"{DEFAULT_CACHE_OUT} with --cache; "
@@ -1188,6 +1488,10 @@ def main() -> int:
         return ingest_cache_smoke()
     if args.spec_smoke:
         return spec_smoke()
+    if args.quant_smoke:
+        return quant_smoke()
+    if args.quant:
+        return quant_measure(args.out or DEFAULT_QUANT_OUT)
     if args.cache:
         return cache_measure(args.out or DEFAULT_CACHE_OUT)
     if args.ingest:

@@ -204,6 +204,8 @@ def engine_row(tag, ps, *, model=None, cfg=None, driver=None, slots=None,
         "kv_bytes_per_slot": st["kv_bytes_per_slot"],
         "peak_blocks": st["peak_blocks"],
         "pool_utilization": st["pool_utilization"],
+        "kv_dtype": st["kv_dtype"],
+        "serve_precision": st["serve_precision"],
         "compile_s": round(compile_s, 1),
     }), flush=True)
     return cps, st
@@ -459,3 +461,35 @@ if os.environ.get("DECODE_PAGED", "1") == "1":
                              f"{st_2x['kv_bytes_per_slot']}",
         "value": round(st_2x["slots"] / st_unpaged["slots"], 2),
     }), flush=True)
+
+    # ----------------------------------------------------------------------
+    # Low-precision serving tiers (cfg.kv_dtype / cfg.serve_precision;
+    # decode/quant.py + docs/DECODE_ENGINE.md "Low-precision tiers"),
+    # riding the paged stream above. The bf16 arena halves the per-
+    # position KV bytes, so the equal-HBM slot-count gain DOUBLES: the
+    # 4xslots row serves four times the unpaged-f32 slots against the
+    # SAME pool bytes (2 x BATCH x W_long bf16 blocks == BATCH unpaged
+    # f32 stripes). The int8w row keeps the f32 arena and swaps the
+    # decode weight tier — throughput at unchanged KV accounting.
+    # Quality vs f32 is measured by serve_bench.py --quant
+    # (docs/QUANT_BENCH_r01.jsonl), not here. DECODE_QUANT=0 skips.
+    # ----------------------------------------------------------------------
+    if os.environ.get("DECODE_QUANT", "1") == "1":
+        cfg_bf = cfg_p.replace(kv_dtype="bf16")
+        engine_row(f"bf16kv_tar{PAGED_TAR}", params_p, model=model_p,
+                   cfg=cfg_bf, driver=drive_paged)
+        _, st_bf4x = engine_row(
+            f"bf16kv_tar{PAGED_TAR}_4xslots", params_p, model=model_p,
+            cfg=cfg_bf, driver=drive_paged, slots=4 * BATCH,
+            pool_blocks=2 * BATCH * w_long)
+        print(json.dumps({
+            "tag": "paged_equal_hbm_slot_gain",
+            "kv_dtype": "bf16",
+            "slots": f"{st_unpaged['slots']} -> {st_bf4x['slots']}",
+            "kv_bytes_per_slot": f"{st_unpaged['kv_bytes_per_slot']} -> "
+                                 f"{st_bf4x['kv_bytes_per_slot']}",
+            "value": round(st_bf4x["slots"] / st_unpaged["slots"], 2),
+        }), flush=True)
+        engine_row(f"int8w_tar{PAGED_TAR}", params_p, model=model_p,
+                   cfg=cfg_p.replace(serve_precision="int8w"),
+                   driver=drive_paged)

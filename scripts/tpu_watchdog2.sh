@@ -111,6 +111,29 @@ for i in $(seq 1 600); do
       FIRA_BENCH_SPEC=1 FIRA_BENCH_PROBE_BUDGET=120 timeout 1400 python bench.py >> "$LOG" 2>&1
       echo "[watchdog2] spec bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
     fi
+    if [ ! -f .watchdog_quant_done ]; then
+      # Low-precision-tier harvest, ONE entry (ISSUE 18): the bf16/int8w
+      # rows of tpu_decode_bench.py (bf16kv_tar64 / bf16kv_tar64_4xslots
+      # + the kv_dtype=bf16 equal-HBM gain row / int8w_tar64) at the
+      # batch-512 production bracket — the TPU side of the HBM-capacity
+      # and weight-tier throughput claims the committed CPU artifact
+      # (docs/QUANT_BENCH_r01.jsonl) records at the tiny geometry. The
+      # quant section rides the paged leg (DECODE_QUANT=1 default), so a
+      # completed bracket this window already carries the rows.
+      if [ "${BRACKET_RAN_THIS_WINDOW:-0}" = 1 ]; then
+        echo "[watchdog2] quant harvest: batch-512 bracket (quant rows included) already completed this window, skipping $(date -u +%FT%TZ)" >> "$LOG"
+        touch .watchdog_quant_done
+      else
+        echo "[watchdog2] quant harvest: decode bracket DECODE_BATCH=512 quant rows $(date -u +%FT%TZ)" >> "$LOG"
+        DECODE_BATCH=512 DECODE_PAGED_TAR=64 timeout 1400 python scripts/tpu_decode_bench.py >> "$LOG" 2>&1
+        QUANT_RC=$?
+        echo "[watchdog2] quant bracket rc=$QUANT_RC $(date -u +%FT%TZ)" >> "$LOG"
+        [ "$QUANT_RC" = 0 ] && touch .watchdog_quant_done
+      fi
+      echo "[watchdog2] quant harvest: bench.py quant leg $(date -u +%FT%TZ)" >> "$LOG"
+      FIRA_BENCH_QUANT=1 FIRA_BENCH_PROBE_BUDGET=120 timeout 1400 python bench.py >> "$LOG" 2>&1
+      echo "[watchdog2] quant bench rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    fi
     echo "[watchdog2] running fullscale_v2 $(date -u +%FT%TZ)" >> "$LOG"
     timeout 7200 python scripts/fullscale_v2.py >> "$LOG" 2>&1
     echo "[watchdog2] fullscale_v2 rc=$? $(date -u +%FT%TZ)" >> "$LOG"

@@ -256,18 +256,23 @@ def test_auto_block_size_and_byte_accounting():
     bs = paging.resolve_block_size(cfg)
     W = paging.blocks_per_seq(cfg.tar_len, bs)
     slots = 8
+    # itemsize comes from the serving tier, not a literal 4 (docs/
+    # DECODE_ENGINE.md "Low-precision tiers")
+    isz = paging.kv_itemsize(cfg)
+    assert isz == 4  # fira_tiny defaults kv_dtype="f32"
+    assert paging.kv_itemsize(cfg.replace(kv_dtype="bf16")) == 2
     # full residency: the paged pool commits exactly the unpaged bytes
     assert paging.kv_bytes_per_slot(
         cfg, paged=True, block_size=bs, pool_blocks=slots * W, slots=slots,
-        itemsize=4) == paging.kv_bytes_per_slot(
+        itemsize=isz) == paging.kv_bytes_per_slot(
         cfg, paged=False, block_size=0, pool_blocks=0, slots=slots,
-        itemsize=4)
+        itemsize=isz)
     # half the pool: half the committed HBM per slot
     assert paging.kv_bytes_per_slot(
         cfg, paged=True, block_size=bs, pool_blocks=slots * W // 2,
-        slots=slots, itemsize=4) == paging.kv_bytes_per_slot(
+        slots=slots, itemsize=isz) == paging.kv_bytes_per_slot(
         cfg, paged=False, block_size=0, pool_blocks=0, slots=slots,
-        itemsize=4) // 2
+        itemsize=isz) // 2
 
 
 def test_paging_errors_named_knob_messages():
