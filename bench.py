@@ -179,6 +179,12 @@ the single-worker ingest rate vs the 1,815 commits/sec/core offline
 preprocessing baseline — and folds its rows into this record; the full
 artifact lands in docs/INGEST_BENCH_r02.jsonl.
 FIRA_BENCH_INGEST_TIMEOUT caps the sweep, default 900 s),
+FIRA_BENCH_DISAGG=1 (opt-in disaggregated-tier leg: runs
+scripts/serve_bench.py --disagg — in-process vs prefill-pool split
+serving on the same prefill-heavy trace at swept virtual-clock rates,
+with the saturation A/B and per-tier knee rows; the committed artifact
+lands in docs/DISAGG_BENCH_r01.jsonl. FIRA_BENCH_DISAGG_TIMEOUT caps
+the sweep, default 900 s),
 
 Composed leg — the production path going forward (ISSUE 4): the stacked
 knobs AND the auto bucket table together. One shuffled epoch plan of
@@ -1050,6 +1056,19 @@ def worker() -> None:
                                   "FIRA_BENCH_INGEST_TIMEOUT",
                                   args=("--ingest",))
 
+    # (k) DISAGG leg (opt-in: FIRA_BENCH_DISAGG=1): disaggregated
+    # serving tiers — scripts/serve_bench.py --disagg serves the same
+    # prefill-heavy trace in-process vs split across a spawned
+    # prefill-worker pool + decode replicas (fira_tpu/serve/disagg.py)
+    # at swept virtual-clock rates and records per-mode throughput /
+    # latency, wall-clock prefill-tier utilization, the saturation A/B,
+    # and per-tier knee rows (docs/SERVING.md "Disaggregated tiers").
+    disagg = None
+    if os.environ.get("FIRA_BENCH_DISAGG", "0") == "1":
+        disagg = _script_rows_leg("disagg", "serve_bench.py",
+                                  "FIRA_BENCH_DISAGG_TIMEOUT",
+                                  args=("--disagg",))
+
     step_time = dt_e2e / steps_per_window
     compute_step_time = dt_compute / steps_per_window
     # metric of record: chip-side throughput (see module docstring "History
@@ -1124,6 +1143,10 @@ def worker() -> None:
         # artifact is docs/INGEST_BENCH_r01.jsonl —
         # scripts/serve_bench.py --ingest)
         **({"ingest": ingest} if ingest else {}),
+        # disaggregated-tier rows (FIRA_BENCH_DISAGG=1; the full
+        # artifact is docs/DISAGG_BENCH_r01.jsonl —
+        # scripts/serve_bench.py --disagg)
+        **({"disagg": disagg} if disagg else {}),
         "feed_stall_frac_sync_assembly": sync_info["feed_stall_frac"],
         "value_e2e_sync_assembly": round(
             batch_size / (dt_sync / steps_per_window) / n_chips, 2),

@@ -55,6 +55,7 @@ _DRIVER_FILES = (
     "fira_tpu/data/grouping.py",
     "fira_tpu/parallel/fleet.py",
     "fira_tpu/serve/server.py",
+    "fira_tpu/serve/disagg.py",
     "fira_tpu/ingest/difftext.py",
     "fira_tpu/ingest/service.py",
     "fira_tpu/ingest/cache.py",

@@ -327,6 +327,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "queued requests is rejected on the spot "
                         "(structured backpressure; recorded). 0 = "
                         "unbounded (default)")
+    p.add_argument("--serve-tiers", default=None,
+                   choices=["off", "prefill-pool"],
+                   help="serve: tier topology (docs/SERVING.md "
+                        "'Disaggregated tiers') — 'off' (default) is "
+                        "in-process serve; 'prefill-pool' runs a pool of "
+                        "prefill worker PROCESSES shipping seat-ready "
+                        "artifacts so decode replicas never dispatch a "
+                        "prefill program. Requires --prefix-cache on and "
+                        "the decode engine; validated at parse time, "
+                        "exit 2")
+    p.add_argument("--prefill-workers", type=int, default=None,
+                   metavar="W",
+                   help="serve: prefill-pool width — worker processes "
+                        "in the prefill tier (each owns a jax runtime; "
+                        "output bytes invariant to W). Must be >= 1 "
+                        "(validated at parse time, exit 2)")
+    p.add_argument("--serve-artifact-budget-mb", type=int, default=None,
+                   metavar="MB",
+                   help="serve: prefill-tier backpressure — total "
+                        "artifact bytes in flight stays under this "
+                        "budget so a fast prefill tier cannot OOM the "
+                        "host. 0 = unbounded; must be >= 0 (validated "
+                        "at parse time, exit 2)")
     p.add_argument("--serve-clock", default="wall",
                    choices=["wall", "virtual"],
                    help="serve: 'wall' (default) paces arrivals in real "
@@ -340,7 +363,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "feeder.device_put, ingest.parse, engine.prefill, "
                         "engine.step, "
                         "engine.harvest, fleet.replica, serve.admit, "
-                        "cache.lookup, ingest.cache; "
+                        "cache.lookup, ingest.cache, disagg.transport, "
+                        "disagg.worker; "
                         "kinds: raise | hang | corrupt). Deterministic "
                         "given the seed — chaos runs replay exactly; "
                         "validated at parse time, exit 2. Off by default "
@@ -567,6 +591,12 @@ def _resolve_cfg(args):
         overrides["serve_deadline_steps"] = args.serve_deadline_steps
     if args.serve_queue_cap is not None:
         overrides["serve_queue_cap"] = args.serve_queue_cap
+    if args.serve_tiers is not None:
+        overrides["serve_tiers"] = args.serve_tiers
+    if args.prefill_workers is not None:
+        overrides["prefill_workers"] = args.prefill_workers
+    if args.serve_artifact_budget_mb is not None:
+        overrides["serve_artifact_budget_mb"] = args.serve_artifact_budget_mb
     if args.inject_faults is not None:
         overrides["inject_faults"] = args.inject_faults
     if args.dispatch_watchdog_s is not None:
@@ -786,6 +816,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from fira_tpu.serve.server import serve_errors
 
         errs += serve_errors(cfg, trace=args.serve_trace is not None)
+        # disaggregated-tier knob admission (topology name, pool width,
+        # in-flight artifact budget, the prefix-cache/decode-engine
+        # requirements) — same exit-2 contract,
+        # serve.disagg.disagg_errors
+        from fira_tpu.serve.disagg import disagg_errors
+
+        errs += disagg_errors(cfg)
     # robustness knob admission (fault-spec grammar, watchdog timeout,
     # quarantine retry count) — same exit-2 contract, every command
     # (the watchdog also guards train's dev gates) —

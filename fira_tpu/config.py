@@ -335,6 +335,36 @@ class FiraConfig:
     # output file keeps the position with an empty line). 0 = unbounded.
     serve_queue_cap: int = 0
 
+    # --- disaggregated serving tiers (serve/disagg.py; docs/SERVING.md
+    # "Disaggregated tiers") ---
+    # Tier topology: "off" = historical in-process serve (prefill and
+    # decode share the scheduler's jax runtime); "prefill-pool" =
+    # DistServe-style process split — a pool of prefill worker processes
+    # (each with its own jax runtime + params) computes seat-ready
+    # artifacts (the prefix-cache payload) and ships them over a
+    # pipe/shared-memory transport, so decode replicas admit every
+    # request through the all-hit cache path and NEVER dispatch a
+    # prefill program post-warmup. Requires prefix_cache and
+    # decode_engine. Must be off|prefill-pool (validated at parse time,
+    # exit 2 — serve.disagg.disagg_errors).
+    serve_tiers: str = "off"
+    # Prefill-pool width: worker processes in the prefill tier. Each
+    # holds a full jax runtime (spawn-context process, the
+    # ingest_exec=process template), so startup costs one runtime init +
+    # per-bucket prefill compile per worker. Output bytes are invariant
+    # to this knob by contract (tests/test_disagg.py). Must be >= 1
+    # (validated at parse time, exit 2 — serve.disagg.disagg_errors).
+    prefill_workers: int = 2
+    # Backpressure bound on the prefill tier: total artifact bytes
+    # in flight (submitted to workers, not yet delivered to the decode
+    # tier's caches) stays under this budget, so a fast prefill tier
+    # cannot OOM the host by racing ahead of decode. Sized from the
+    # per-row artifact estimate the worker ready-handshake reports; a
+    # single over-budget group alone still ships (same degrade rule as
+    # the prefix cache's byte cap). 0 = unbounded. Must be >= 0
+    # (validated at parse time, exit 2 — serve.disagg.disagg_errors).
+    serve_artifact_budget_mb: int = 64
+
     # --- online raw-diff ingest (ingest/; docs/INGEST.md) ---
     # Feeder workers dedicated to per-request diff ingest tasks (parse +
     # AST extraction + encode + single-row assembly, run worker-side so
@@ -397,7 +427,7 @@ class FiraConfig:
     # injection points along the request path (sites: feeder.assemble,
     # feeder.device_put, ingest.parse, engine.prefill, engine.step,
     # engine.harvest, fleet.replica, serve.admit, cache.lookup,
-    # ingest.cache; kinds:
+    # ingest.cache, disagg.transport, disagg.worker; kinds:
     # raise | hang | corrupt).
     # Deterministic given the seed — every chaos run replays exactly —
     # and validated at parse time (robust.faults.robust_errors, CLI

@@ -1058,6 +1058,16 @@ class SlotEngine:
         batches into hit/miss chunks with this — serve/server.py)."""
         return self._cache is not None and self._cache.contains(digest)
 
+    def cache_put(self, digest, payload) -> None:
+        """Seed one externally-prefilled artifact payload (the
+        disaggregated prefill tier's delivery seam — serve/disagg.py):
+        the next admission of this digest takes the all-hit cache path —
+        host assemble + one device_put, ZERO prefill dispatches on this
+        replica. Same eviction meter as a miss-fill; a no-op without a
+        cache (cfg.prefix_cache off) or for a pad digest."""
+        if self._cache is not None and digest is not None:
+            self.stats.cache_evictions += self._cache.put(digest, payload)
+
     def cache_clear(self) -> None:
         """Drop every cached prefill entry (bench hygiene: a warm pass
         must not hand the timed window its hits)."""

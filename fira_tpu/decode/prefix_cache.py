@@ -120,6 +120,16 @@ def payload_nbytes(payload: Dict[str, np.ndarray]) -> int:
     return sum(int(np.asarray(v).nbytes) for v in payload.values())
 
 
+def payload_checksum(payload: Dict[str, np.ndarray]) -> str:
+    """Content checksum of one per-row artifact payload — the SAME keyed
+    digest the cache's integrity check uses, exported as the transport
+    verification seam: a prefill worker stamps it at produce time
+    (serve/disagg.py), the decode side recomputes it at seat, and any
+    in-flight scramble (the ``disagg.transport`` corrupt site) is caught
+    as a mismatch and re-prefilled — never a wrong answer."""
+    return _digest_arrays(sorted(payload.items()))
+
+
 def extract_payloads(chunk_host: Dict[str, np.ndarray], rows: List[int],
                      beam: int) -> Dict[int, Dict[str, np.ndarray]]:
     """Slice one prefilled chunk's HOST copy into per-row cache payloads.
@@ -281,8 +291,7 @@ class PrefixCache:
             payload = self._faults.corrupt("cache.lookup", self._lookups,
                                            payload)
             if (entry.checksum is not None
-                    and _digest_arrays(sorted(payload.items()))
-                    != entry.checksum):
+                    and payload_checksum(payload) != entry.checksum):
                 del self._lru[digest]
                 self._nbytes -= entry.nbytes
                 return None, "integrity_drop"
@@ -300,7 +309,7 @@ class PrefixCache:
             self._nbytes -= old.nbytes
         entry = _Entry(
             payload=payload,
-            checksum=(_digest_arrays(sorted(payload.items()))
+            checksum=(payload_checksum(payload)
                       if self._integrity() else None),
             nbytes=payload_nbytes(payload))
         self._lru[digest] = entry

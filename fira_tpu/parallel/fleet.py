@@ -290,6 +290,16 @@ class EngineFleet:
         replica's (geometry x {prefill, step, insert}) labels."""
         return [lbl for e in self.engines for lbl in e.labels(table)]
 
+    def cache_put(self, digest, payload) -> None:
+        """Fan one externally-prefilled artifact payload out to EVERY
+        live replica's prefix cache (the disaggregated prefill tier's
+        delivery seam — serve/disagg.py): whichever replica's rotation
+        claims the request, its admission takes the all-hit cache path.
+        The payload is host numpy shared by reference — the caches store
+        it read-only and ``build_chunk`` re-packs copies at seat."""
+        for eng in self.engines:
+            eng.cache_put(digest, payload)
+
     def prewarm(self, warm_batches) -> None:
         """Compile every replica's prefill family up front (each replica
         owns its own executables — per-device compiles are real compiles,

@@ -66,15 +66,33 @@ SITES = (
     #                       wrong answer); corrupt => the read payload is
     #                       scrambled, the entry's content checksum catches
     #                       it, and the entry is dropped
+    "disagg.transport",   # a prefill-tier artifact delivery at the
+    #                       decode-side receive boundary
+    #                       (serve/disagg.py): raise => the message is
+    #                       treated as lost and its requests resubmit to
+    #                       the pool; hang sleeps the receive; corrupt
+    #                       scrambles the shipped payload — the per-row
+    #                       content checksum catches it at seat and the
+    #                       row re-prefills (never a wrong answer)
+    "disagg.worker",      # one prefill-worker work item (child-side,
+    #                       serve/disagg.py _worker_main): raise kills
+    #                       the worker PROCESS (the uncaught exception
+    #                       exits it) => the parent retires the worker
+    #                       and requeues its in-flight work to
+    #                       survivors; all-workers-lost => recorded
+    #                       in-process prefill fallback; hang sleeps
+    #                       inside the child (the lifecycle watchdog's
+    #                       prey)
 )
 KINDS = ("raise", "hang", "corrupt")
 # corrupt scrambles a HOST payload in place; only the sites that own a
 # host payload qualify (every other site is a dispatch boundary with
-# nothing host-mutable): batch assembly, raw-diff ingest assembly, and
-# the two content-cache read paths (whose checksums must catch the
-# scramble — docs/FAULTS.md)
+# nothing host-mutable): batch assembly, raw-diff ingest assembly, the
+# two content-cache read paths, and the disagg transport's shipped
+# artifact rows (whose checksums must catch the scramble —
+# docs/FAULTS.md)
 CORRUPT_SITES = ("feeder.assemble", "ingest.parse", "ingest.cache",
-                 "cache.lookup")
+                 "cache.lookup", "disagg.transport")
 
 
 class InjectedFault(RuntimeError):

@@ -10,10 +10,17 @@ batches from live arrivals, caps prefill/step interleaving with a
 per-dispatch prefill budget, sheds on backpressure (bounded queue,
 per-request deadlines — rejection recorded, never a hang), and meters
 per-request TTFT and end-to-end latency for the p50/p99 bench
-(scripts/serve_bench.py -> docs/SERVE_BENCH_r01.jsonl).
+(scripts/serve_bench.py -> docs/SERVE_BENCH_r01.jsonl). With
+``serve_tiers=prefill-pool`` (disagg.py — docs/SERVING.md
+"Disaggregated tiers") prefill moves off the decode replicas entirely:
+a spawn-pool of prefill worker processes ships seat-ready artifacts
+over a pipe/shared-memory transport and decode admits every request
+through the prefix cache's all-hit path.
 """
 
 from fira_tpu.serve.arrivals import (poisson_times, read_trace,  # noqa: F401
                                      write_trace)
+from fira_tpu.serve.disagg import (PrefillTier, TierStats,  # noqa: F401
+                                   disagg_errors)
 from fira_tpu.serve.server import (RequestRecord, ServeStats,  # noqa: F401
                                    serve_errors, serve_split)

@@ -83,6 +83,7 @@ from fira_tpu.decode.stream import OrderedStreamWriter
 from fira_tpu.model.model import FiraModel
 from fira_tpu.robust import faults as faults_lib
 from fira_tpu.robust.watchdog import WatchdogTimeout, run_with_watchdog
+from fira_tpu.serve import disagg as disagg_lib
 
 # serve_metrics snapshot cadence: the partial artifact refreshes every
 # this many scheduler rounds (plus once at startup and once on abort),
@@ -225,6 +226,16 @@ class RequestRecord:
     # (``_ingest``) and copied here at arrival. None on corpus-graph
     # requests, which never ran ingest.
     ingest: Optional[Dict] = None
+    # disaggregated prefill-tier lifecycle stamps (docs/SERVING.md
+    # "Disaggregated tiers"): wall seconds from first tier sighting to
+    # pool submission (prefill_queue_s), submission to checksum-verified
+    # delivery into the decode tier's caches (transport_s — the full
+    # tier round trip, worker compute included), and the delivered
+    # artifact's host footprint. None whenever serve_tiers=off, so
+    # tier-less records stay byte-stable.
+    prefill_queue_s: Optional[float] = None
+    transport_s: Optional[float] = None
+    artifact_bytes: Optional[int] = None
 
     @property
     def queue_wait_s(self) -> float:
@@ -307,6 +318,12 @@ class ServeStats:
     # configured feeder_depth, so the actually-applied bound is
     # recorded rather than silently diverging from the knob
     ingest_pipeline: Optional[tuple] = None
+    # disaggregated prefill-tier meter (serve/disagg.TierStats; docs/
+    # SERVING.md "Disaggregated tiers"): a zero-arg callable returning
+    # the tier's summary dict, bound by serve_split so the final
+    # summary reads END-of-run counters — None with serve_tiers=off, so
+    # tier-less summaries stay byte-stable (the ingest_cache pattern)
+    tiers: Optional[object] = None
 
     def summary(self) -> Dict:
         done = [r for r in self.records if r.status == "done"]
@@ -359,6 +376,9 @@ class ServeStats:
             "mean_e2e_s": round(float(np.mean(e2e)), 6) if e2e else None,
             "p50_queue_wait_s": _pct(qw, 50), "p99_queue_wait_s": _pct(qw, 99),
             **self._ingest_summary(),
+            **({"tiers": dict(self.tiers()
+                              if callable(self.tiers) else self.tiers)}
+               if self.tiers is not None else {}),
         }
 
     def _ingest_summary(self) -> Dict:
@@ -433,7 +453,7 @@ class ServeLoop:
                  arrival_times: np.ndarray, feed, table, assignment,
                  templates: Dict[int, Dict], clock, emit, shed,
                  refill_order: str = "fifo", faults=None, snapshot=None,
-                 positions=None, journal=None, recovery=None):
+                 positions=None, journal=None, recovery=None, tier=None):
         self.engines = list(engines)
         self.cfg = cfg
         self.clock = clock
@@ -495,6 +515,12 @@ class ServeLoop:
         # always-on alive/heartbeat record (satellite of ROADMAP item 3)
         self._journal = journal
         self._recovery = recovery
+        # disaggregated prefill tier (serve/disagg.PrefillTier, None =
+        # in-process serve): while alive it OWNS every miss's prefill —
+        # the admission walk holds tier-held misses queued until their
+        # artifacts land in the replicas' caches and they admit as hits,
+        # so the decode tier never dispatches a prefill program
+        self._tier = tier
         self._shed_log: List[Dict] = []   # round-buffered shed WAL records
         self._alive_changed()
 
@@ -733,6 +759,14 @@ class ServeLoop:
                 continue
             if probe and eng.cache_contains(e.digest):
                 hits.append(e)
+            elif self._tier is not None and self._tier.holds(e.digest):
+                # the prefill tier owns this miss (docs/SERVING.md
+                # "Disaggregated tiers"): hold it queued — NEVER a
+                # prefill dispatch on this decode replica — until its
+                # shipped artifacts land and it re-walks as a hit. The
+                # tier going dead or giving the digest up flips holds()
+                # false and the next walk takes the in-process path.
+                rest.append(e)
             else:
                 misses.append(e)
         held: List[_Queued] = []
@@ -1138,12 +1172,26 @@ class ServeLoop:
                 self._flush_shed_log()
                 break
             self._poll_arrivals(self.clock.now())
+            if self._tier is not None:
+                # disaggregated prefill tier tick (serve/disagg.py):
+                # sweep dead workers, deliver checksum-verified
+                # artifacts into every replica's cache, submit fresh
+                # misses — pure host work before admission, so this
+                # round's walk can already seat freshly-landed hits
+                self._tier.service(self._queue, self.engines)
             self._shed_deadlines()
             self._admit()
             live = [e for e in self.engines if e.in_flight()]
             if not live:
                 if self._queue or self._promoted \
                         or any(e.staged_rows for e in self.engines):
+                    if self._tier is not None \
+                            and not any(e.staged_rows
+                                        for e in self.engines):
+                        # nothing dispatchable and the queue is waiting
+                        # on the prefill tier: block briefly on the
+                        # worker pipes instead of busy-spinning
+                        self._tier.idle_wait(0.05)
                     continue    # seats free up / budget admits next round
                 if self._arr_idx < n:
                     # idle: jump (virtual) / sleep (wall) to the next
@@ -1558,6 +1606,7 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
             f"arrival trace has {n_req} requests but split {split!r} holds "
             f"only {len(data)} samples")
     errs = serve_errors(cfg, trace=True)
+    errs += disagg_lib.disagg_errors(cfg)
     if errs:
         raise ValueError("; ".join(errs))
     clk = make_clock(clock, step_cost_s=step_cost_s,
@@ -1652,6 +1701,20 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
         recovery = recovery_lib.RecoveryManager(
             owner, cfg, wall_clock=(clock == "wall"))
 
+    # disaggregated prefill tier (serve/disagg.py; docs/SERVING.md
+    # "Disaggregated tiers"): spawn the worker pool AFTER the decode
+    # templates exist (the workers warm the same per-bucket prefill
+    # family) — each child gets the ORIGINAL f32 params as host numpy
+    # (prefill always runs f32, whatever the decode tier's precision)
+    tier = None
+    if cfg.serve_tiers != "off":
+        import jax
+
+        params_host = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x)), params)
+        tier = disagg_lib.PrefillTier(params_host, cfg,
+                                      templates=templates, faults=faults)
+
     bleu_by_pos: Dict[int, float] = {}
     snapshot = metrics_snapshotter(metrics_path, owner, faults)
     journal = (recovery_lib.Journal(journal_path, n=n_req, times=times,
@@ -1688,10 +1751,16 @@ def serve_split(model: FiraModel, params, dataset: FiraDataset,
                 shed=lambda rec: writer.add(rec.position, "\n"),
                 refill_order=refill_order, faults=faults,
                 snapshot=snapshot, positions=positions, journal=journal,
-                recovery=recovery)
+                recovery=recovery, tier=tier)
             loop.stats.resumed = len(recovered)
+            if tier is not None:
+                # end-of-run counters, the ingest_cache pattern: the
+                # summary closure reads the tier's final meters
+                loop.stats.tiers = tier.stats.summary
             stats = run_loop_guarded(loop, snapshot)
     finally:
+        if tier is not None:
+            tier.close()
         if journal is not None:
             journal.close()
     # resource-lifecycle oracle (analysis.sanitizer.LeakGuard): with the

@@ -19,7 +19,13 @@ degradation contracts machine-verifiably:
 Modes:
   --smoke     one seeded fault per site + a corrupt leg + a watchdog-hang
               leg, each checked against the contracts above under the
-              armed compile guard. Exit nonzero on any violation — the
+              armed compile guard, plus the disaggregated-tier legs
+              (docs/SERVING.md "Disaggregated tiers"): transport
+              raise/corrupt (lost message => resubmit, corrupt artifact
+              => checksum-caught re-prefill), worker death => retire +
+              requeue to survivors, all-workers-lost => recorded
+              in-process fallback — bytes equal to the no-fault drain
+              in every case. Exit nonzero on any violation — the
               scripts/check.sh tier-1 leg.
   --recovery-smoke
               the SELF-HEALING contracts (robust/recovery.py; docs/
@@ -481,6 +487,60 @@ def smoke() -> int:
             "whole_diff_hits": meter.get("hits"),
             "fault_misses": meter.get("fault_misses"),
             "integrity_drops": meter.get("integrity_drops"),
+            "compiles_after_warmup": extra_compiles,
+        })
+
+    # --- disaggregated-tier legs (serve/disagg.py; docs/SERVING.md
+    # "Disaggregated tiers"): faults on the prefill-pool's transport and
+    # worker processes must degrade — lost message => resubmit, corrupt
+    # artifact => checksum-caught re-prefill, dead worker => retire +
+    # requeue to survivors, ALL workers lost => recorded in-process
+    # fallback — and in every case the output bytes stay EXACTLY the
+    # no-fault drain bytes. Never a wrong answer, never a hang.
+    ref_bytes = "\n".join(ref_lines).encode()
+    disagg_legs = [
+        # (leg name, workers, fault spec, contract key)
+        ("disagg.transport:raise", 2,
+         "disagg.transport:raise:0.3:7", "transport_msgs_lost"),
+        ("disagg.transport:corrupt", 2,
+         "disagg.transport:corrupt:0.3:7", "transport_integrity_drops"),
+        ("disagg.worker:raise", 2,
+         "disagg.worker:raise:0.12:5", "workers_lost"),
+        ("disagg.worker:all-lost", 1,
+         "disagg.worker:raise:0.6:7", "fallback"),
+    ]
+    for leg, n_workers, spec, meter_key in disagg_legs:
+        c = ccfg.replace(serve_tiers="prefill-pool",
+                         prefill_workers=n_workers, inject_faults=spec)
+        inj = faults_lib.injector_from(c)
+        with sanitizer.sanitize(nans=False, infs=False) as guard:
+            m = serve_split(model, params, dataset, c,
+                            arrival_times=times,
+                            out_dir=os.path.join(work,
+                                                 leg.replace(":", "_")),
+                            split="train", clock="virtual", guard=guard,
+                            faults=inj)
+            extra_compiles = guard.compiles_after_warmup()
+        sv = m["serve"]
+        tiers = sv.get("tiers") or {}
+        # worker faults fire inside the CHILD process (its own injector,
+        # rebuilt from cfg), so the parent-side fired count stays 0 for
+        # them — the observable contract meter IS the firing evidence.
+        fired = sum(m.get("faults", {}).values()) + tiers.get(
+            "workers_lost", 0)
+        metered = tiers.get(meter_key, 0)
+        leg_ok = (fired > 0 and bool(metered)
+                  and sv["completed"] == n and extra_compiles == 0
+                  and open(m["output_path"], "rb").read() == ref_bytes)
+        ok = ok and leg_ok
+        results.append({
+            "leg": leg, "ok": leg_ok, "fired": fired,
+            "completed": sv["completed"],
+            "workers_lost": tiers.get("workers_lost"),
+            "fallback": tiers.get("fallback"),
+            "msgs_lost": tiers.get("transport_msgs_lost"),
+            "integrity_drops": tiers.get("transport_integrity_drops"),
+            "rows_resubmitted": tiers.get("rows_resubmitted"),
             "compiles_after_warmup": extra_compiles,
         })
 

@@ -17,7 +17,8 @@ echo "== firacheck: static JAX-hazard scan =="
 # fira_tpu/decode/paging.py, fira_tpu/decode/prefix_cache.py,
 # fira_tpu/decode/spec.py, fira_tpu/decode/quant.py,
 # fira_tpu/parallel/fleet.py,
-# fira_tpu/serve/server.py, fira_tpu/ingest/difftext.py,
+# fira_tpu/serve/server.py, fira_tpu/serve/disagg.py,
+# fira_tpu/ingest/difftext.py,
 # fira_tpu/ingest/service.py, fira_tpu/ingest/cache.py,
 # fira_tpu/robust/faults.py,
 # fira_tpu/robust/watchdog.py and fira_tpu/robust/recovery.py — the
@@ -27,8 +28,9 @@ echo "== firacheck: static JAX-hazard scan =="
 # geometry/validation, the cross-request prefix cache, the speculative
 # draft-and-verify decode programs, the low-precision serving tiers
 # (KV-arena dtype + decode weight quantization), the replicated
-# decode fleet, the arrival-timed serving loop, the raw-diff ingest
-# pipeline (+ its whole-diff result cache / hunk memo / process
+# decode fleet, the arrival-timed serving loop, the disaggregated
+# prefill-pool tier (worker pump/drain + transport), the raw-diff
+# ingest pipeline (+ its whole-diff result cache / hunk memo / process
 # executor) and the fault-injection/watchdog/recovery machinery. Their
 # threaded/packing/refill/admission loops MUST stay in the self-scan
 # even if the directory arguments ever change — the DRIVER-REG lint
@@ -42,7 +44,8 @@ JAX_PLATFORMS=cpu python -m fira_tpu.analysis.cli check \
     fira_tpu/decode/paging.py fira_tpu/decode/prefix_cache.py \
     fira_tpu/decode/spec.py fira_tpu/decode/quant.py \
     fira_tpu/parallel/fleet.py \
-    fira_tpu/serve/server.py fira_tpu/ingest/difftext.py \
+    fira_tpu/serve/server.py fira_tpu/serve/disagg.py \
+    fira_tpu/ingest/difftext.py \
     fira_tpu/ingest/service.py fira_tpu/ingest/cache.py \
     fira_tpu/robust/faults.py \
     fira_tpu/robust/watchdog.py fira_tpu/robust/recovery.py \
@@ -148,6 +151,17 @@ echo "== quant smoke: low-precision tiers serve a tiny stream (docs/DECODE_ENGIN
 # must stamp the tier, and zero post-warmup compiles must hold from the
 # tier-suffixed program family.
 JAX_PLATFORMS=cpu python scripts/serve_bench.py --quant-smoke || exit $?
+
+echo "== disagg smoke: prefill-pool serve == drain bytes (docs/SERVING.md 'Disaggregated tiers') =="
+# The disaggregated prefill tier stays machine-enforced in tier-1: a
+# fixed trace served with serve_tiers=prefill-pool (2 spawned prefill
+# workers shipping artifacts over the pipe/SHM transport) under the
+# armed compile guard — output bytes must equal the plain drain, every
+# artifact must be transport-delivered (ZERO decode-tier prefill
+# dispatches — decode seats exclusively through the prefix cache's
+# all-hit path), no fallback, and zero post-warmup compiles on the
+# decode tier.
+JAX_PLATFORMS=cpu python scripts/serve_bench.py --disagg-smoke || exit $?
 
 echo "== chaos smoke: seeded fault at each site (docs/FAULTS.md) =="
 # The graceful-degradation contracts stay machine-enforced in tier-1:

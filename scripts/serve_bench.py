@@ -108,6 +108,21 @@ Modes:
               measured BLEU-delta bound, bf16 halves kv_bytes_per_slot,
               zero post-warmup retraces (the check.sh leg). Exit
               nonzero on any violation.
+  --disagg    the TIER-SPLIT leg (docs/DISAGG_BENCH_r01.jsonl;
+              docs/SERVING.md "Disaggregated tiers"): in-process vs
+              prefill-pool serving on the same prefill-heavy
+              all-distinct trace at swept virtual-clock rates —
+              per-mode throughput/latency rows with wall-clock
+              prefill-tier utilization, a saturation A/B (disagg rps
+              must beat in-process at the top rate), and per-tier knee
+              rows machine-naming the first tier to saturate. Byte
+              identity asserted per rate; exit nonzero on violation.
+  --disagg-smoke
+              prefill-pool serve bytes == plain drain bytes with every
+              artifact delivered over the pipe/SHM transport, ZERO
+              decode-tier prefill dispatches, no fallback, and zero
+              post-warmup compiles on the decode tier (the check.sh
+              leg). Exit nonzero on any violation.
 
 Env knobs: FIRA_SERVE_COMMITS (synthetic corpus size, default 600),
 FIRA_SERVE_RATE_FRACS (default "0.25,0.5,0.8,1.2,1.6" x drain capacity),
@@ -125,6 +140,9 @@ Ingest leg: FIRA_INGEST_COMMITS (default 300), FIRA_INGEST_RATE_FRACS
 "1,2,4"), FIRA_INGEST_EXEC_MODES (parse-stage exec modes swept, default
 "thread,process"), FIRA_INGEST_REPEATS (repeat-mix rates, default
 "0.6").
+Disagg leg: FIRA_DISAGG_COMMITS (default 48), FIRA_DISAGG_RATES
+(virtual-clock offered rps swept, default "0.5,2.0,8.0"),
+FIRA_DISAGG_WORKERS (default 2).
 """
 
 from __future__ import annotations
@@ -144,6 +162,8 @@ DEFAULT_CACHE_OUT = os.path.join(REPO_ROOT, "docs", "CACHE_BENCH_r01.jsonl")
 DEFAULT_INGEST_OUT = os.path.join(REPO_ROOT, "docs",
                                   "INGEST_BENCH_r02.jsonl")
 DEFAULT_QUANT_OUT = os.path.join(REPO_ROOT, "docs", "QUANT_BENCH_r01.jsonl")
+DEFAULT_DISAGG_OUT = os.path.join(REPO_ROOT, "docs",
+                                  "DISAGG_BENCH_r01.jsonl")
 
 # the offline preprocessing baseline the online ingest rate is compared
 # against (docs/PERF.md § Preprocessing: host-side shard workers over
@@ -1440,6 +1460,213 @@ def quant_measure(out_path: str) -> int:
     return 0 if ok else 1
 
 
+def disagg_smoke() -> int:
+    """Disaggregated-tier equivalence leg (scripts/check.sh,
+    docs/SERVING.md "Disaggregated tiers"): a ``serve_tiers=
+    prefill-pool`` serve under the armed compile guard must produce
+    BYTE-IDENTICAL output to the plain drain, with every request
+    actually delivered over the pipe/shared-memory transport
+    (rows_delivered == n, zero decode-tier prefill dispatches — the
+    decode replicas seat exclusively through the prefix cache's all-hit
+    path), no recorded fallback, and zero post-warmup compiles on the
+    decode tier. Exit nonzero on any violation."""
+    import dataclasses
+
+    from fira_tpu.analysis import sanitizer
+    from fira_tpu.decode.runner import run_test
+    from fira_tpu.serve import poisson_times
+
+    dataset, _corpus, cfg, model, params = _setup(
+        40, batch=6, slots=6, eos_delta=4.0, buckets=((16, 400, 12),))
+    cfg = dataclasses.replace(cfg, prefix_cache=True)
+    n = len(dataset.splits["train"])
+    times = poisson_times(n, rate=0.5, seed=3)  # virtual-clock units
+    work = tempfile.mkdtemp(prefix="fira_disagg_smoke_")
+
+    drain = run_test(model, params, dataset, cfg,
+                     out_dir=os.path.join(work, "drain"), split="train")
+    ref = open(drain["output_path"], "rb").read()
+
+    dcfg = dataclasses.replace(cfg, serve_tiers="prefill-pool",
+                               prefill_workers=2)
+    with sanitizer.sanitize(nans=False, infs=False) as guard:
+        served, m = _serve_row(model, params, dataset, dcfg, times,
+                               os.path.join(work, "disagg"), guard=guard,
+                               clock="virtual")
+        extra = guard.compiles_after_warmup()
+    got = open(m["output_path"], "rb").read()
+    tiers = served.get("tiers") or {}
+    decode_prefills = m["engine"]["prefills"]
+    ok = (got == ref and extra == 0 and served["completed"] == n
+          and tiers.get("rows_delivered", 0) == n
+          and not tiers.get("fallback", True)
+          and tiers.get("rows_given_up", 1) == 0
+          and decode_prefills == 0)
+    print(json.dumps({
+        "smoke": "ok" if ok else "FAIL",
+        "bytes_equal_drain": got == ref,
+        "compiles_after_warmup": extra,
+        "completed": served["completed"], "offered": n,
+        "rows_delivered": tiers.get("rows_delivered"),
+        "decode_prefills": decode_prefills,
+        "fallback": tiers.get("fallback"),
+        "shm_segments": tiers.get("shm_segments"),
+        "p50_e2e_virtual": served["p50_e2e_s"],
+        "p99_e2e_virtual": served["p99_e2e_s"],
+    }), flush=True)
+    return 0 if ok else 1
+
+
+def disagg_measure(out_path: str) -> int:
+    """The TIER-SPLIT record (docs/DISAGG_BENCH_r01.jsonl; docs/
+    SERVING.md "Disaggregated tiers"): in-process serving vs the
+    prefill-pool split on the SAME prefill-heavy all-distinct trace
+    (long prefixes, EOS-biased short settles — the shape where prefill
+    dominates the decode replica's dispatch mix) at swept offered
+    rates on the deterministic virtual clock.
+
+    The clock model is the equal-total-cores accounting: the virtual
+    clock charges the DECODE tier's dispatches only (prefills via
+    ``on_prefill``, steps per dispatch), so the in-process rows pay
+    every prefill on the serving clock while the disagg rows seat
+    through the cache's all-hit path and pay none — the structural
+    claim (DistServe OSDI'24 §3: prefill off the decode critical path)
+    isolated from this box's 1-core contention, which a wall-clock A/B
+    would re-introduce as the workers' compute stealing the decode
+    tier's core. The prefill tier's own cost is NOT hidden: each disagg
+    row records wall-clock ``prefill_util`` (worker busy seconds /
+    workers x span) next to decode ``slot_occupancy``, and the knee
+    rows machine-name the first tier to saturate from exactly those
+    two utilizations. Byte identity in-process vs disagg is asserted
+    per rate (exit nonzero on violation).
+
+    Env: FIRA_DISAGG_COMMITS (default 48), FIRA_DISAGG_RATES (default
+    "0.5,2.0,8.0" — virtual-clock offered rps), FIRA_DISAGG_WORKERS
+    (default 2)."""
+    import dataclasses
+
+    from fira_tpu.serve import poisson_times
+
+    n_commits = int(os.environ.get("FIRA_DISAGG_COMMITS", "48"))
+    rates = [float(r) for r in os.environ.get(
+        "FIRA_DISAGG_RATES", "0.5,2.0,8.0").split(",")]
+    workers = int(os.environ.get("FIRA_DISAGG_WORKERS", "2"))
+
+    dataset, _corpus, cfg, model, params = _setup(
+        n_commits, batch=6, slots=6, eos_delta=4.0,
+        buckets=((16, 400, 12),))
+    base = dataclasses.replace(cfg, prefix_cache=True)
+    dcfg = dataclasses.replace(base, serve_tiers="prefill-pool",
+                               prefill_workers=workers)
+    n = len(dataset.splits["train"])
+    work = tempfile.mkdtemp(prefix="fira_disagg_bench_")
+
+    rows = []
+
+    def emit(row):
+        rows.append(row)
+        print(json.dumps(row, sort_keys=True), flush=True)
+
+    ok = True
+    sweep = {"in-process": [], "disagg": []}
+    for rate in rates:
+        times = poisson_times(n, rate=rate, seed=7)
+        bytes_by_mode = {}
+        for mode, c in (("in-process", base), ("disagg", dcfg)):
+            sv, m = _serve_row(model, params, dataset, c, times,
+                               os.path.join(work, f"{mode}_r{rate}"),
+                               clock="virtual")
+            bytes_by_mode[mode] = open(m["output_path"], "rb").read()
+            tiers = sv.get("tiers") or {}
+            busy = float(tiers.get("prefill_busy_s", 0.0))
+            util = (busy / (workers * sv["wall_s"])
+                    if mode == "disagg" and sv["wall_s"] else None)
+            row = {
+                "mode": "tier_split", "serve_mode": mode,
+                "offered_rps_virtual": rate, "n_requests": n,
+                "prefill_workers": workers if mode == "disagg" else 0,
+                "throughput_rps_virtual": sv["throughput_rps"],
+                "p50_e2e_virtual": sv["p50_e2e_s"],
+                "p99_e2e_virtual": sv["p99_e2e_s"],
+                "p50_ttft_virtual": sv["p50_ttft_s"],
+                "p99_ttft_virtual": sv["p99_ttft_s"],
+                "completed": sv["completed"],
+                "decode_prefills": m["engine"]["prefills"],
+                "decode_slot_occupancy": m["engine"]["slot_occupancy"],
+                "prefill_util_wall": (round(util, 4)
+                                      if util is not None else None),
+                "rows_delivered": tiers.get("rows_delivered"),
+                "artifact_bytes": tiers.get("artifact_bytes"),
+                "peak_inflight_bytes": tiers.get("peak_inflight_bytes"),
+                "host": "cpu-tiny (fira_tiny geometry; virtual clock "
+                        "charges decode-tier dispatches only — shapes "
+                        "are the artifact, not absolute numbers)",
+            }
+            emit(row)
+            sweep[mode].append(row)
+        if bytes_by_mode["in-process"] != bytes_by_mode["disagg"]:
+            ok = False
+            emit({"mode": "byte_identity_FAIL",
+                  "offered_rps_virtual": rate})
+
+    # --- saturation A/B: at the top swept rate (past both knees by
+    # construction) the disagg decode tier, relieved of every prefill
+    # dispatch, must answer at a strictly higher virtual rate.
+    top = max(rates)
+    inproc_top = [r for r in sweep["in-process"]
+                  if r["offered_rps_virtual"] == top][0]
+    disagg_top = [r for r in sweep["disagg"]
+                  if r["offered_rps_virtual"] == top][0]
+    beats = (disagg_top["throughput_rps_virtual"]
+             > inproc_top["throughput_rps_virtual"])
+    ok = ok and beats
+    emit({"mode": "saturation_ab", "offered_rps_virtual": top,
+          "inproc_rps_virtual": inproc_top["throughput_rps_virtual"],
+          "disagg_rps_virtual": disagg_top["throughput_rps_virtual"],
+          "disagg_beats_inproc": beats,
+          "note": "equal total cores: virtual clock charges decode "
+                  "dispatches; prefill-tier load reported as "
+                  "prefill_util_wall on the sweep rows"})
+
+    # --- per-tier knee rows: smallest swept rate each serve mode fails
+    # to answer at >= 0.9x offered, with the saturating tier machine-
+    # named from the measured utilizations at that rate (disagg: the
+    # busier of prefill_util_wall vs decode slot occupancy; in-process:
+    # the only tier there is).
+    for mode in ("in-process", "disagg"):
+        sat = [r for r in sweep[mode]
+               if r["throughput_rps_virtual"]
+               < 0.9 * r["offered_rps_virtual"]]
+        under = [r for r in sweep[mode] if r not in sat]
+        knee = {"mode": "knee", "serve_mode": mode,
+                "knee_offered_rps_virtual": max(
+                    (r["offered_rps_virtual"] for r in under),
+                    default=None)}
+        if mode == "disagg" and sat:
+            first = sat[0]
+            pu = first["prefill_util_wall"] or 0.0
+            du = first["decode_slot_occupancy"] or 0.0
+            knee["knee_tier"] = "prefill" if pu > du else "decode"
+            knee["prefill_util_wall"] = pu
+            knee["decode_slot_occupancy"] = du
+        elif sat:
+            knee["knee_tier"] = "decode"
+        else:
+            knee["knee_tier"] = None
+        emit(knee)
+
+    stamp = {"generated_by": "scripts/serve_bench.py --disagg",
+             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        f.write(json.dumps(stamp, sort_keys=True) + "\n")
+        for r in rows:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+    print(json.dumps({"disagg_bench": "ok" if ok else "FAIL",
+                      "rows": len(rows), "out": out_path}), flush=True)
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -1469,6 +1696,13 @@ def main() -> int:
     ap.add_argument("--quant-smoke", action="store_true",
                     help="tiers: per-tier byte-stability + measured BLEU "
                          "bound + zero retraces (scripts/check.sh)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated-tier split leg "
+                         "(docs/DISAGG_BENCH_r01.jsonl)")
+    ap.add_argument("--disagg-smoke", action="store_true",
+                    help="prefill-pool serve bytes == drain bytes with "
+                         "every artifact transport-delivered + zero "
+                         "decode prefills leg (scripts/check.sh)")
     ap.add_argument("--out", default=None,
                     help=f"JSONL record path (default {DEFAULT_OUT}; "
                          f"{DEFAULT_CACHE_OUT} with --cache; "
@@ -1490,6 +1724,10 @@ def main() -> int:
         return spec_smoke()
     if args.quant_smoke:
         return quant_smoke()
+    if args.disagg_smoke:
+        return disagg_smoke()
+    if args.disagg:
+        return disagg_measure(args.out or DEFAULT_DISAGG_OUT)
     if args.quant:
         return quant_measure(args.out or DEFAULT_QUANT_OUT)
     if args.cache:

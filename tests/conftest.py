@@ -28,3 +28,114 @@ REFERENCE_ROOT = "/root/reference"
 
 def reference_available() -> bool:
     return os.path.isdir(os.path.join(REFERENCE_ROOT, "OUTPUT"))
+
+
+# --------------------------------------------------------------------------
+# Tier-1 wall-clock budget ledger (ROADMAP "Tier-1 verify": the suite must
+# finish inside an 870 s timeout on a 1-core rig; the full suite measures
+# ~2460 s). The nodeids below are the measured-heaviest tests — profiled
+# 2026-08-07 via FIRA_T1_PROFILE on this box — auto-marked `slow` at
+# collection so the fast lane keeps at least one cheap byte-identity /
+# parity representative PER SUBSYSTEM (mesh parity, bucket geometry, fleet
+# replica invariance, serve replay, prefix cache, paged KV, spec decode,
+# respawn recovery, ingest, disaggregated tiers) while the heavy redundant
+# variants run in the slow lane (`-m slow`). A data-driven ledger beats 65
+# scattered decorators: one provenance-stamped list, regenerate by
+# re-profiling. Fast-lane cost after the cut: ~620 s measured.
+TIER1_SLOW_NODEIDS = frozenset((
+    "tests/test_multichip.py::test_mesh_n_data1_bitwise_equals_single_chip",            # 221.5 s
+    "tests/test_grouping.py::test_train_fused_buckets_zero_retraces_and_profiles_real_program",  # 117.4 s
+    "tests/test_multichip.py::test_mesh_grouped_buckets_zero_retraces",                 # 66.7 s
+    "tests/test_train_decode.py::test_fused_steps_training_matches_per_step",           # 60.1 s
+    "tests/test_grouping.py::test_grouped_fused_bit_exact_vs_per_step_bucketed",        # 55.3 s
+    "tests/test_grouping.py::test_train_accum_buckets_composes",                        # 49.5 s
+    "tests/test_buckets.py::test_train_and_decode_end_to_end_with_buckets",             # 48.2 s
+    "tests/test_train_decode.py::test_multi_step_matches_sequential_steps",             # 43.8 s
+    "tests/test_train_decode.py::test_mesh_matches_single_device_loss[split_buffer]",   # 42.9 s
+    "tests/test_train_decode.py::test_grouped_steps_mesh_smoke[fused_steps]",           # 41.2 s
+    "tests/test_train_decode.py::test_mesh_matches_single_device_loss[flat_scatter]",   # 39.5 s
+    "tests/test_cli.py::test_decode_is_batch_size_invariant",                           # 36.9 s
+    "tests/test_buckets.py::test_tar_bucketed_engine_file_bytes_deterministic",         # 35.8 s
+    "tests/test_train_decode.py::test_rng_impl_rbg_same_init_different_dropout",        # 34.9 s
+    "tests/test_grouping.py::test_grouped_accum_tail_pads_all_invalid_at_bucket_geometry",  # 34.7 s
+    "tests/test_buckets.py::test_bucket_program_family_compile_counts",                 # 34.5 s
+    "tests/test_train_decode.py::test_factored_topk_beam_matches_fused",                # 32.5 s
+    "tests/test_sanitizer.py::test_compile_count_regression_unfused_and_fused",         # 31.7 s
+    "tests/test_typed_edges.py::test_extensions_compose",                               # 30.9 s
+    "tests/test_spec.py::test_spec_file_bytes_invariant_to_k_cadence_and_paging",       # 30.3 s
+    "tests/test_sanitizer.py::test_guard_wiring_through_train_loop",                    # 26.8 s
+    "tests/test_train_decode.py::test_train_end_to_end_tiny",                           # 26.7 s
+    "tests/test_train_decode.py::test_grouped_steps_mesh_smoke[accum_steps]",           # 26.5 s
+    "tests/test_train_decode.py::test_accum_tail_padding_matches_plain_step",           # 25.8 s
+    "tests/test_train_decode.py::test_mesh_matches_single_device_loss[parity]",         # 24.2 s
+    "tests/test_train_decode.py::test_accum_step_matches_big_batch_gradient",           # 23.8 s
+    "tests/test_bench_harness.py::test_bench_harness_cpu_success",                      # 23.0 s
+    "tests/test_train_decode.py::test_ablation_configs_train_and_decode[nothing]",      # 22.5 s
+    "tests/test_fleet.py::test_fleet_bucketed_zero_retraces_and_file_identical",        # 21.9 s
+    "tests/test_serve.py::test_cli_serve_end_to_end",                                   # 21.9 s
+    "tests/test_paged_kv.py::test_paged_file_identical_zero_retraces_single_and_fleet",  # 21.3 s
+    "tests/test_train_decode.py::test_accum_steps_training_runs_and_counts_steps",      # 21.0 s
+    "tests/test_buckets.py::test_bucket_geometry_bit_exact_loss_and_decode[dense]",     # 20.5 s
+    "tests/test_spec.py::test_spec_fleet_replica_invariance",                           # 20.2 s
+    "tests/test_train_decode.py::test_ablation_configs_train_and_decode[no_edit]",      # 19.9 s
+    "tests/test_train_decode.py::test_prefetch_to_device_matches_direct_feed",          # 19.5 s
+    "tests/test_cli.py::test_train_production_preset_tiny",                             # 19.1 s
+    "tests/test_robust.py::test_kill_mid_serve_leaves_partial_output_and_metrics",      # 18.9 s
+    "tests/test_train_decode.py::test_ablation_configs_train_and_decode[no_subtoken]",  # 18.9 s
+    "tests/test_recovery.py::test_dedup_follower_completes_after_leader_death",         # 17.1 s
+    "tests/test_model.py::TestPerfKnobs::test_copy_head_remat_off_identical_loss_and_grads",  # 17.1 s
+    "tests/test_fleet.py::test_fleet_refill_interleaving_invariance",                   # 16.5 s
+    "tests/test_buckets.py::test_bucket_geometry_bit_exact_loss_and_decode[bf16_wire]",  # 16.3 s
+    "tests/test_train_decode.py::test_kv_cached_beam_matches_full_redecode",            # 16.3 s
+    "tests/test_typed_edges.py::test_gains_receive_gradients",                          # 15.9 s
+    "tests/test_paged_kv.py::test_insert_never_zeroes_cache_and_dirty_arena_reuse",     # 15.6 s
+    "tests/test_robust.py::test_train_dev_gate_watchdog_skips_wedged_gate",             # 15.1 s
+    "tests/test_engine.py::test_engine_slot_count_decoupled_from_batch",                # 14.2 s
+    "tests/test_copy_score.py::TestModelIntegration::test_grad_equivalence",            # 13.9 s
+    "tests/test_spec.py::test_spec_bit_exact_per_sample[False-True-draft]",             # 13.0 s
+    "tests/test_bench_killcontract.py::test_sigkill_at_random_times_leaves_parseable_tail",  # 13.0 s
+    "tests/test_ring.py::TestModelRingIntegration::test_loss_matches_dense",            # 13.0 s
+    "tests/test_spec.py::test_spec_bit_exact_per_sample[True-False-draft]",             # 12.5 s
+    "tests/test_spec.py::test_spec_copy_tier_acceptance_saturates_when_target_blind",   # 11.6 s
+    "tests/test_recovery.py::test_respawn_bytes_identical_under_seeded_fault[2]",       # 11.2 s
+    "tests/test_recovery.py::test_spare_pool_attach_zero_compiles",                     # 11.2 s
+    "tests/test_spec.py::test_spec_bit_exact_per_sample[False-False-copy]",             # 11.1 s
+    "tests/test_spec.py::test_spec_stall_cooldown_falls_back_to_plain",                 # 10.2 s
+    "tests/test_prefix_cache.py::test_cache_hit_bit_exact_vs_cold[True-False-True]",    # 9.1 s
+    "tests/test_train_decode.py::test_f32_checkpoint_decodes_in_bf16",                  # 9.1 s
+    "tests/test_paged_kv.py::test_undersized_pool_head_of_line_deterministic",          # 9.0 s
+    "tests/test_engine.py::test_engine_kill_mid_run_leaves_parseable_prefix",           # 8.5 s
+    "tests/test_prefix_cache.py::test_lru_eviction_under_undersized_cache_deterministic",  # 8.2 s
+    "tests/test_prefix_cache.py::test_cache_hit_bit_exact_vs_cold[True-True-False]",    # 8.1 s
+    "tests/test_fleet.py::test_fleet_replicas_work_on_distinct_devices",                # 8.1 s
+))
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    for item in items:
+        if item.nodeid in TIER1_SLOW_NODEIDS:
+            item.add_marker(pytest.mark.slow)
+
+
+# Streaming per-test timing: FIRA_T1_PROFILE=<path> appends one JSONL row
+# per finished test call. Unlike --durations, rows survive a timeout kill
+# of the session, so the tier-1 budget ledger can be rebuilt even when the
+# suite overruns the wall (how the slow-mark set is chosen; the ledger
+# above is its product).
+_PROFILE_PATH = os.environ.get("FIRA_T1_PROFILE")
+
+if _PROFILE_PATH:
+    import json
+    import time
+
+    def pytest_runtest_logreport(report):
+        if report.when != "call":
+            return
+        with open(_PROFILE_PATH, "a") as fh:
+            fh.write(json.dumps({
+                "test": report.nodeid,
+                "s": round(report.duration, 3),
+                "outcome": report.outcome,
+            }) + "\n")
